@@ -1,0 +1,27 @@
+"""csat_tpu — a TPU-native (JAX/XLA/Pallas) code-summarization framework.
+
+A ground-up rebuild of the capabilities of CSA-Trans
+("Code Structure Aware Transformer for AST", arXiv 2404.05767;
+reference implementation: saeyoon17/Code-Structure-Aware-Transformer):
+
+* AST preprocessing into pre-order sequences plus signed ancestor (L) and
+  sibling (T) relative-distance matrices (reference: ``my_ast.py``).
+* A Code Structure Embedder (CSE) built on disentangled relative-position
+  attention (reference: ``module/disentangled_attn.py``) producing a learned
+  per-node positional encoding, plus four alternative PE variants
+  (laplacian / sequential / treepos / triplet).
+* A Stochastic-Block-Model sparse-attention encoder with straight-through
+  Bernoulli mask sampling (reference: ``module/sbm_attn.py``, ``module/STE.py``)
+  and a sparsity-regularized training objective.
+* A transformer decoder with greedy decoding, BLEU-4 / ROUGE-L / METEOR
+  evaluation, and a data-parallel training harness.
+
+Everything on the compute path is JAX: ``jit``-compiled training and decoding,
+``jax.custom_vjp`` for the STE, batched linear algebra for the Laplacian PE,
+``jax.sharding.Mesh`` + ``shard_map``/``NamedSharding`` for multi-chip
+execution, and Pallas TPU kernels for the attention hot paths.
+"""
+
+__version__ = "0.1.0"
+
+from csat_tpu.configs import Config, get_config, list_configs  # noqa: F401

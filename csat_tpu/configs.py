@@ -1,0 +1,178 @@
+"""Config system: typed dataclasses plus a named-variant registry.
+
+The reference drives experiments through 15 executable-Python config modules
+(``/root/reference/config/*.py``) that bind hyperparameters and classes and are
+loaded via ``py_config_runner`` (``/root/reference/main.py:22``).  Here the
+same experiment surface is config-as-data: one frozen dataclass, and a
+registry with one entry per reference config file.  Variants differ only in
+``use_pegen`` / ``full_att`` / dims / ``data_dir`` — verified by diffing every
+reference config against ``config/python.py``.
+
+New TPU-specific axes (not present in the reference):
+
+* ``backend``: ``"xla"`` or ``"pallas"`` — which implementation of the two
+  attention hot paths to run (the north-star config switch).
+* ``param_dtype`` / ``compute_dtype``: bf16 compute with fp32 attention
+  islands replaces the reference's AMP GradScaler machinery
+  (``script/train.py:96,166``; ``module/sbm_attn.py:120-126``).
+* ``mesh_shape``: named device-mesh axes for data/tensor parallelism
+  (replaces the NCCL DDP launch path, ``script/train.py:331``).
+* ``decode_with_cache``: KV-cache greedy decoding (the reference re-runs the
+  full decoder on the growing prefix each step,
+  ``module/base_seq2seq.py:136-143``; a cache-free compat mode is kept for
+  A/B testing).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional, Tuple
+
+
+@dataclasses.dataclass(frozen=True)
+class Config:
+    # experiment identity
+    name: str = "python"
+    project_name: str = "final_exp"
+    task_name: str = "default"
+    lang: str = "python"  # "python" | "java" — selects triplet vocab etc.
+
+    # model (reference defaults: config/python.py)
+    seed: int = 2021
+    sw: float = 1e-2  # sparsity-regularizer weight (train.py:109)
+    use_pegen: str = "pegen"  # pegen|laplacian|sequential|treepos|triplet
+    pe_dim: int = 256
+    pegen_dim: int = 512
+    sbm_enc_dim: int = 512
+    num_layers: int = 4  # CSE depth
+    sbm_layers: int = 4
+    clusters: Tuple[int, ...] = (10, 10, 10, 10)
+    full_att: bool = False
+    num_heads: int = 8
+    hidden_size: int = 512
+    dim_feed_forward: int = 2048
+    dropout: float = 0.2
+    attention_dropout: float = 0.2  # fixed 0.2 in reference (csa_trans.py:152)
+    decoder_layers: int = 4  # hardcoded 4 in reference (csa_trans.py:161)
+    tree_pos_width: int = 8  # treepos degree (csa_trans.py:134)
+    tree_pos_height: int = 16  # treepos depth (csa_trans.py:133)
+
+    # data
+    data_dir: str = "./processed/tree_sitter_python"
+    max_tgt_len: int = 50
+    max_src_len: int = 150
+    data_type: str = "pot"
+    src_vocab_cap: int = 10_000  # utils/vocab.py:175
+    tgt_vocab_cap: int = 20_000  # utils/vocab.py:185
+
+    # train
+    batch_size: int = 64
+    num_epochs: int = 500
+    learning_rate: float = 1e-4
+    smoothing: float = 0.0  # label smoothing (config/python.py:52)
+    val_interval: int = 5
+    save_interval: int = 50
+
+    # eval / checkpointing
+    is_test: bool = False
+    testfile: str = ""
+    output_dir: str = "./outputs"
+
+    # --- TPU-native axes (no reference equivalent) ---
+    backend: str = "xla"  # "xla" | "pallas"
+    param_dtype: str = "float32"
+    compute_dtype: str = "float32"  # "bfloat16" for MXU-friendly training
+    mesh_shape: Tuple[Tuple[str, int], ...] = (("data", 1), ("model", 1))
+    decode_with_cache: bool = True
+    # reference-compat quirk flags (SURVEY.md §8) — default reproduces
+    generator_dropout: bool = True  # dropout-before-softmax Generator quirk
+
+    @property
+    def head_dim(self) -> int:
+        return self.sbm_enc_dim // self.num_heads
+
+    @property
+    def src_emb_dim(self) -> int:
+        # src embedding sized sbm_enc_dim - pe_dim (csa_trans.py:93-98);
+        # sequential configs set pe_dim=0 so this is the full width.
+        return self.sbm_enc_dim - self.pe_dim
+
+    def replace(self, **kw) -> "Config":
+        return dataclasses.replace(self, **kw)
+
+    def validate(self) -> None:
+        assert self.use_pegen in (
+            "pegen",
+            "laplacian",
+            "sequential",
+            "treepos",
+            "triplet",
+        ), self.use_pegen
+        assert self.backend in ("xla", "pallas"), self.backend
+        assert self.sbm_enc_dim % self.num_heads == 0
+        assert len(self.clusters) == self.sbm_layers
+        if self.use_pegen == "sequential":
+            assert self.pe_dim == 0, "sequential PE uses pe_dim=0 (config/python_seq.py)"
+        else:
+            assert 0 < self.pe_dim < self.sbm_enc_dim
+        if self.use_pegen == "treepos":
+            assert self.pegen_dim % (self.tree_pos_width * self.tree_pos_height) == 0
+
+
+# ---------------------------------------------------------------------------
+# Registry: one named variant per reference config file (config/*.py).
+# ---------------------------------------------------------------------------
+
+_PY = Config(
+    name="python",
+    task_name="256_512_512_4_4_10_10_10_10_b64_tgt50_vanilla",
+    lang="python",
+    data_dir="./processed/tree_sitter_python",
+)
+
+_JAVA = _PY.replace(
+    name="java",
+    task_name="128_768_512_4_4_10_10_10_10_b64_tgt50_10k_20k_java",
+    lang="java",
+    pe_dim=128,
+    sbm_enc_dim=768,
+    data_dir="./processed/tree_sitter_java",
+)
+
+_REGISTRY = {}
+
+
+def _reg(cfg: Config) -> Config:
+    cfg.validate()
+    _REGISTRY[cfg.name] = cfg
+    return cfg
+
+
+_reg(_PY)
+_reg(_PY.replace(name="python_full_att", full_att=True))
+_reg(_PY.replace(name="python_lap", use_pegen="laplacian"))
+_reg(_PY.replace(name="python_seq", use_pegen="sequential", pe_dim=0, pegen_dim=0))
+_reg(_PY.replace(name="python_treepos", use_pegen="treepos"))
+_reg(_PY.replace(name="python_triplet", use_pegen="triplet"))
+_reg(_PY.replace(name="python_compare_asttrans", data_dir="./processed_ast_trans_data/tree_sitter_python"))
+_reg(_PY.replace(name="python_compare_codescribe", data_dir="./processed/compare_codescribe_python"))
+_reg(_JAVA)
+_reg(_JAVA.replace(name="java_full_att", full_att=True))
+_reg(_JAVA.replace(name="java_lap", use_pegen="laplacian"))
+_reg(_JAVA.replace(name="java_seq", use_pegen="sequential", pe_dim=0, pegen_dim=0))
+_reg(_JAVA.replace(name="java_treepos", use_pegen="treepos"))
+_reg(_JAVA.replace(name="java_triplet", use_pegen="triplet"))
+_reg(_JAVA.replace(name="java_compare_codescribe", data_dir="./processed/compare_codescribe_java"))
+
+
+def get_config(name: str, **overrides) -> Config:
+    """Look up a named variant; keyword overrides are applied on top."""
+    cfg = _REGISTRY[name]
+    if overrides:
+        cfg = cfg.replace(**overrides)
+        cfg.validate()
+    return cfg
+
+
+def list_configs() -> Tuple[str, ...]:
+    return tuple(sorted(_REGISTRY))

@@ -1,0 +1,10 @@
+from csat_tpu.data.ast_tools import (  # noqa: F401
+    Node,
+    ast_json_to_tree,
+    preorder,
+    truncate_preorder,
+    build_matrices,
+    split_variable,
+)
+from csat_tpu.data.vocab import Vocab, create_vocab, load_vocab  # noqa: F401
+from csat_tpu.data.dataset import ASTDataset, Batch, collate  # noqa: F401

@@ -1,0 +1,113 @@
+"""Preprocessing driver: ``ast.original`` JSON lines → on-disk training artifacts.
+
+Capability parity with ``/root/reference/process.py`` + ``my_ast.py``:
+for each split, parse every JSON AST, truncate to ``max_ast_len`` nodes
+pre-order, emit ``split_pot.seq`` (stringified label-list 1-tuples, one per
+line) and ``split_matrices.npz`` (tree records + L/T matrices), copy
+``nl.original``; then build vocabs.  Parallel over samples with a process
+pool (the reference fans out with joblib n_jobs=30, ``my_ast.py:22,49-52``).
+
+Usage::
+
+    python -m csat_tpu.data.preprocess --data_dir ./data/tree_sitter_python \
+        --max_ast_len 150 --process --make_vocab
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import shutil
+from concurrent.futures import ProcessPoolExecutor
+from typing import List, Tuple
+
+import numpy as np
+
+from csat_tpu.data.ast_tools import (
+    TreeRecord,
+    ast_json_to_tree,
+    build_matrices,
+    tree_to_record,
+    truncate_preorder,
+)
+from csat_tpu.data.vocab import create_vocab
+
+__all__ = ["process_split", "process_dataset"]
+
+SPLITS = ("train", "dev", "test")
+
+
+def _process_one(args: Tuple[str, int]):
+    line, max_size = args
+    root = ast_json_to_tree(json.loads(line))
+    seq = truncate_preorder(root, max_size)
+    L, T = build_matrices(seq, max_size)
+    rec = tree_to_record(seq)
+    levels = np.zeros(max_size, dtype=np.int32)
+    levels[: len(rec)] = rec.levels
+    return rec, levels, L, T
+
+
+def process_split(split_dir: str, max_ast_len: int, n_jobs: int = 0) -> int:
+    """Process one split directory containing ``ast.original`` (+ ``nl.original``)."""
+    ast_path = os.path.join(split_dir, "ast.original")
+    with open(ast_path, "r", encoding="utf-8") as f:
+        lines = [ln for ln in f.read().splitlines() if ln.strip()]
+
+    work = [(ln, max_ast_len) for ln in lines]
+    if n_jobs and n_jobs > 1:
+        with ProcessPoolExecutor(max_workers=n_jobs) as ex:
+            results = list(ex.map(_process_one, work, chunksize=64))
+    else:
+        results = [_process_one(w) for w in work]
+
+    records: List[TreeRecord] = []
+    levels, Ls, Ts, pot_lines = [], [], [], []
+    for rec, lvl, L, T in results:
+        records.append(rec)
+        levels.append(lvl)
+        # store L/T compactly; collate re-derives masks from raw distances
+        Ls.append(L.astype(np.int16))
+        Ts.append(T.astype(np.int16))
+        pot_lines.append(str((rec.labels,)))
+
+    from csat_tpu.data.dataset import save_matrices
+
+    save_matrices(os.path.join(split_dir, "split_matrices.npz"), records, levels, Ls, Ts)
+    with open(os.path.join(split_dir, "split_pot.seq"), "w", encoding="utf-8") as f:
+        f.write("\n".join(pot_lines))
+    return len(records)
+
+
+def process_dataset(data_dir: str, max_ast_len: int, make_vocab: bool = True, n_jobs: int = 0) -> None:
+    for split in SPLITS:
+        split_dir = os.path.join(data_dir, split)
+        if not os.path.exists(os.path.join(split_dir, "ast.original")):
+            continue
+        n = process_split(split_dir, max_ast_len, n_jobs=n_jobs)
+        print(f"{split}: processed {n} ASTs (max {max_ast_len} nodes)")
+    if make_vocab:
+        src_v, tgt_v, trip_v = create_vocab(data_dir)
+        print(
+            f"vocabs: ast={src_v.size()} nl={tgt_v.size()} triplet={trip_v.size()}"
+        )
+
+
+def main() -> None:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--data_dir", required=True)
+    p.add_argument("--max_ast_len", type=int, default=150)
+    p.add_argument("--process", action="store_true")
+    p.add_argument("--make_vocab", action="store_true")
+    p.add_argument("--n_jobs", type=int, default=os.cpu_count() or 1)
+    args = p.parse_args()
+    if args.process:
+        process_dataset(args.data_dir, args.max_ast_len, make_vocab=False, n_jobs=args.n_jobs)
+    if args.make_vocab:
+        src_v, tgt_v, trip_v = create_vocab(args.data_dir)
+        print(f"vocabs: ast={src_v.size()} nl={tgt_v.size()} triplet={trip_v.size()}")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,17 @@
+"""Shared constants and small helpers.
+
+Mirrors the special-token table of the reference (``utils/vocab.py:10-19``):
+PAD=0, UNK=1, BOS=2, EOS=3 with the same surface forms.
+"""
+
+from csat_tpu.utils.tokens import (  # noqa: F401
+    PAD,
+    UNK,
+    BOS,
+    EOS,
+    PAD_WORD,
+    UNK_WORD,
+    BOS_WORD,
+    EOS_WORD,
+    SELF_WORD,
+)

@@ -1,0 +1,16 @@
+"""Special-token ids shared across vocab, datasets, model and metrics.
+
+Reference parity: ``/root/reference/utils/vocab.py:10-19`` and
+``/root/reference/my_ast.py:11-20``.
+"""
+
+PAD = 0
+UNK = 1
+BOS = 2
+EOS = 3
+
+SELF_WORD = "<self>"
+PAD_WORD = "<pad>"
+UNK_WORD = "<unk>"
+BOS_WORD = "<s>"
+EOS_WORD = "</s>"

@@ -1,0 +1,50 @@
+"""Test env: force an 8-device virtual CPU platform BEFORE jax import.
+
+This is the JAX-native fake-distributed backend the reference lacks entirely
+(SURVEY.md §4): every multi-chip test runs against a virtual 8-device mesh.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
+
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def synthetic_corpus(tmp_path_factory):
+    """Small preprocessed corpus shared across the test session."""
+    from csat_tpu.data.synthetic import make_corpus
+
+    data_dir = str(tmp_path_factory.mktemp("corpus"))
+    make_corpus(data_dir, n_train=96, n_dev=24, n_test=24, seed=0)
+    return data_dir
+
+
+@pytest.fixture(scope="session")
+def tiny_config():
+    from csat_tpu.configs import get_config
+
+    return get_config(
+        "python",
+        pe_dim=16,
+        pegen_dim=32,
+        sbm_enc_dim=64,
+        hidden_size=64,
+        num_heads=4,
+        num_layers=2,
+        sbm_layers=2,
+        clusters=(4, 4),
+        dim_feed_forward=128,
+        max_src_len=64,
+        max_tgt_len=12,
+        batch_size=8,
+        dropout=0.1,
+        attention_dropout=0.1,
+        tree_pos_width=4,
+        tree_pos_height=8,
+    )

@@ -1,0 +1,155 @@
+"""Data-layer invariants (SURVEY.md §4 recommended tests)."""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from csat_tpu.data.ast_tools import (
+    ast_json_to_tree,
+    build_matrices,
+    preorder,
+    split_variable,
+    tree_to_record,
+    truncate_preorder,
+)
+from csat_tpu.data.dataset import (
+    ASTDataset,
+    collate,
+    gen_tree_positions,
+    iterate_batches,
+    load_matrices,
+    node_triplets,
+)
+from csat_tpu.data.vocab import Vocab, load_vocab, read_pot_file
+from csat_tpu.utils import BOS, EOS, PAD, UNK
+
+
+def _chain_ast():
+    # module -> (func -> (id -> tok), block -> (stmt1, stmt2, stmt3))
+    return [
+        {"label": "nont:module:0:0:1", "children": ["r:2", "r:6"]},
+        {"label": "nont:func:0:0:2", "children": ["r:3"]},
+        {"label": "nont:identifier:0:0:3", "children": ["r:4"]},
+        {"label": "idt:getValue:0:0:4", "children": ["r:5"]},
+        {"label": "idt:now:0:0:5"},
+        {"label": "nont:block:0:0:6", "children": ["r:7", "r:8", "r:9"]},
+        {"label": "nont:stmt:0:0:7"},
+        {"label": "nont:stmt:0:0:8"},
+        {"label": "nont:stmt:0:0:9"},
+    ]
+
+
+def test_tree_build_and_labels():
+    root = ast_json_to_tree(_chain_ast())
+    seq = truncate_preorder(root, 64)
+    assert [n.num for n in seq] == list(range(9))
+    assert seq[0].label == "nont:module:1"
+    assert seq[0].level == 0 and seq[1].level == 1 and seq[3].level == 3
+    # preorder: module, func, id, getValue, now, block, stmt, stmt, stmt
+    assert [n.value for n in seq] == [
+        "module", "func", "identifier", "getValue", "now", "block", "stmt", "stmt", "stmt",
+    ]
+
+
+def test_LT_matrix_semantics():
+    root = ast_json_to_tree(_chain_ast())
+    seq = truncate_preorder(root, 16)
+    L, T = build_matrices(seq, 16)
+    # antisymmetry
+    assert np.array_equal(L, -L.T)
+    assert np.array_equal(T, -T.T)
+    # ancestor distances: module(0) -> now(4) is 4 levels down
+    assert L[0, 4] == 4 and L[4, 0] == -4
+    assert L[0, 1] == 1 and L[1, 2] == 1 and L[0, 2] == 2
+    # unrelated pair (func subtree vs block subtree)
+    assert L[2, 6] == 0
+    # siblings: children of block are nodes 6,7,8 -> gaps 1,1,2
+    assert T[6, 7] == 1 and T[7, 8] == 1 and T[6, 8] == 2 and T[8, 6] == -2
+    # children of module: func(1), block(5)
+    assert T[1, 5] == 1
+    # self-distances are 0 (the "masked self-pair" quirk source)
+    assert np.all(np.diag(L) == 0) and np.all(np.diag(T) == 0)
+
+
+def test_truncation_prunes_children():
+    root = ast_json_to_tree(_chain_ast())
+    seq = truncate_preorder(root, 7)  # drops the last two stmts
+    assert len(seq) == 7
+    assert [n.num for n in seq] == list(range(7))
+    block = seq[5]
+    assert len(block.children) == 1  # stmt 7,8 pruned
+
+
+def test_split_variable():
+    assert split_variable("getValue_nowHTTPCall") == ["get", "value", "now", "http", "call"]
+
+
+def test_vocab_roundtrip(tmp_path):
+    v = Vocab(need_bos=True, file_path=str(tmp_path / "v.pkl"))
+    v.generate_dict([["a", "b", "a"], ["c", "a"]], max_vocab_size=6)
+    assert v.w2i["a"] == 4  # most frequent first, after 4 specials
+    assert v.size() == 6  # 4 specials + cap leaves room for 2
+    v2 = Vocab(need_bos=True, file_path=str(tmp_path / "v.pkl")).load()
+    assert v2.w2i == v.w2i
+    assert v2.decode(v2.encode(["a", "zzz"])) == ["a", "<unk>"]
+
+
+def test_corpus_artifacts(synthetic_corpus):
+    # reference-format artifacts exist and parse
+    pot = read_pot_file(os.path.join(synthetic_corpus, "train", "split_pot.seq"))
+    assert len(pot) == 96
+    assert all(lab.count(":") >= 2 for lab in pot[0])
+    mats = load_matrices(os.path.join(synthetic_corpus, "train", "split_matrices.npz"))
+    for key in ("root_first_seq", "root_first_level", "L", "T", "parent", "brother"):
+        assert key in mats.files
+    src_v, tgt_v = load_vocab(synthetic_corpus)
+    assert src_v.w2i["<pad>"] == PAD and tgt_v.w2i["</s>"] == EOS
+
+
+def test_dataset_and_collate(synthetic_corpus, tiny_config):
+    cfg = tiny_config.replace(data_dir=synthetic_corpus)
+    src_v, tgt_v = load_vocab(synthetic_corpus)
+    ds = ASTDataset(cfg, "train", src_v, tgt_v, use_cache=False)
+    assert len(ds) == 96
+    batch = next(iterate_batches(ds, 8, shuffle=False))
+    N = cfg.max_src_len
+    assert batch.src_seq.shape == (8, N)
+    assert batch.tgt_seq.shape == (8, cfg.max_tgt_len - 1)
+    assert batch.L.shape == (8, N, N)
+    # masks computed from raw distances BEFORE offset: diagonal must be masked
+    assert bool(batch.L_mask[0, 0, 0]) and bool(batch.T_mask[0, 0, 0])
+    # offset distances land mid-table for self-pairs
+    assert batch.L[0, 0, 0] == N // 2
+    assert batch.L.min() >= 0 and batch.L.max() <= N - 1
+    # tgt starts with BOS
+    assert np.all(batch.tgt_seq[:, 0] == BOS)
+    # every target row ends with EOS somewhere
+    assert all(EOS in row for row in batch.target)
+    # adj marks |L|<=1
+    assert batch.adj[0, 0, 0] == 1.0
+
+
+def test_triplets_and_treepos(synthetic_corpus):
+    mats = load_matrices(os.path.join(synthetic_corpus, "train", "split_matrices.npz"))
+    rec = mats["root_first_seq"][0]
+    trips = node_triplets(rec)
+    assert trips[0] == "(0, 0, 0)"
+    assert len(trips) == len(rec)
+    tp = gen_tree_positions(rec, width=4, height=8)
+    assert tp.shape == (len(rec), 32)
+    # root row all zeros; each non-root row has depth-many one-hots
+    assert np.all(tp[0] == 0)
+    child_rows = tp[1:]
+    assert np.all(child_rows.sum(axis=1) >= 1)
+
+
+def test_host_sharded_loader(synthetic_corpus, tiny_config):
+    cfg = tiny_config.replace(data_dir=synthetic_corpus)
+    src_v, tgt_v = load_vocab(synthetic_corpus)
+    ds = ASTDataset(cfg, "dev", src_v, tgt_v, use_cache=False)
+    b0 = list(iterate_batches(ds, 4, shuffle=False, num_shards=2, shard_index=0))
+    b1 = list(iterate_batches(ds, 4, shuffle=False, num_shards=2, shard_index=1))
+    assert len(b0) == len(b1) == 3  # 24 samples / 2 shards / batch 4
+    assert not np.array_equal(b0[0].src_seq, b1[0].src_seq)
