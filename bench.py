@@ -1,0 +1,83 @@
+"""Benchmark: AST-nodes/sec/chip on the flagship training step.
+
+Prints ONE JSON line:
+    {"metric": "ast_nodes_per_sec_per_chip", "value": N, "unit": "nodes/s/chip",
+     "vs_baseline": R}
+
+Workload = the reference's default Python config (``config/python.py``):
+pegen CSE (4 disentangled-attention layers) + 4-layer SBM sparse-attention
+encoder + 4-layer decoder, batch 64, N=150 AST nodes — one full training
+step (forward, label-smoothed loss + sparsity regularizer, backward, AdamW).
+Throughput counts padded AST nodes (batch × max_src_len) per optimizer step,
+matching the per-batch accounting of the reference's timing harness
+(``csa_trans_time_memory.py``).
+
+``vs_baseline`` compares against the PyTorch reference measured by
+``tools/bench_torch_baseline.py`` on the same host (stored in
+``baseline_torch.json``); 0.0 when no baseline measurement exists.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+
+import jax
+import numpy as np
+
+
+def main() -> None:
+    from csat_tpu.configs import get_config
+    from csat_tpu.data.toy import random_batch
+    from csat_tpu.train.loop import make_train_step
+    from csat_tpu.train.state import create_train_state, default_optimizer, make_model
+
+    cfg = get_config("python", batch_size=64)
+    if cfg.compute_dtype != "float32":
+        cfg = cfg.replace(compute_dtype="float32")
+    src_v, tgt_v, trip_v = 10_000, 20_000, 1246
+    batch = random_batch(cfg, cfg.batch_size, src_v, tgt_v, trip_v, seed=0)
+    batch = jax.tree.map(jax.device_put, batch)
+
+    model = make_model(cfg, src_v, tgt_v, trip_v)
+    tx = default_optimizer(cfg)
+    state = create_train_state(model, tx, batch, seed=cfg.seed)
+    step = make_train_step(model, tx, cfg)
+
+    # compile + warmup
+    state, metrics = step(state, batch)
+    jax.block_until_ready(metrics["loss"])
+
+    n_steps = 20
+    t0 = time.perf_counter()
+    for _ in range(n_steps):
+        state, metrics = step(state, batch)
+    jax.block_until_ready(metrics["loss"])
+    dt = time.perf_counter() - t0
+
+    n_chips = jax.device_count()
+    nodes = cfg.batch_size * cfg.max_src_len * n_steps
+    nodes_per_sec_per_chip = nodes / dt / n_chips
+
+    baseline = 0.0
+    base_path = os.path.join(os.path.dirname(os.path.abspath(__file__)), "baseline_torch.json")
+    if os.path.exists(base_path):
+        with open(base_path) as f:
+            baseline = float(json.load(f).get("ast_nodes_per_sec_per_chip", 0.0))
+    vs = nodes_per_sec_per_chip / baseline if baseline > 0 else 0.0
+
+    print(
+        json.dumps(
+            {
+                "metric": "ast_nodes_per_sec_per_chip",
+                "value": round(nodes_per_sec_per_chip, 1),
+                "unit": "nodes/s/chip",
+                "vs_baseline": round(vs, 3),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,87 @@
+"""CLI entry point.
+
+Capability parity with ``/root/reference/main.py`` + ``script/train.py``'s
+``run_summary``: pick a named config variant, optionally override
+hyperparameters, train with periodic validation, then run the final test
+pass and dump predictions.
+
+Usage::
+
+    python -m csat_tpu.cli --config python --data_dir ./processed/tree_sitter_python
+    python -m csat_tpu.cli --config python_full_att --epochs 20 --is_test ...
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+
+import jax
+
+
+def main() -> None:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--config", required=True, help="named variant, e.g. python, java_full_att")
+    p.add_argument("--data_dir", default="", help="override the config's data_dir")
+    p.add_argument("--exp_type", default="summary", choices=["summary"])
+    p.add_argument("--epochs", type=int, default=0, help="override num_epochs")
+    p.add_argument("--batch_size", type=int, default=0)
+    p.add_argument("--is_test", action="store_true", help="skip training, evaluate a checkpoint")
+    p.add_argument("--checkpoint_dir", default="", help="orbax checkpoint dir for --is_test/resume")
+    p.add_argument("--backend", default="", choices=["", "xla", "pallas"])
+    p.add_argument("--platform", default="", help="force jax platform (cpu/tpu)")
+    args = p.parse_args()
+
+    if args.platform:
+        jax.config.update("jax_platforms", args.platform)
+
+    from csat_tpu.configs import get_config, list_configs
+    from csat_tpu.data.dataset import ASTDataset
+    from csat_tpu.train import Trainer, run_test
+
+    if args.config not in list_configs():
+        raise SystemExit(f"unknown config {args.config!r}; choose from {list_configs()}")
+    overrides = {}
+    if args.data_dir:
+        overrides["data_dir"] = args.data_dir
+    if args.epochs:
+        overrides["num_epochs"] = args.epochs
+    if args.batch_size:
+        overrides["batch_size"] = args.batch_size
+    if args.backend:
+        overrides["backend"] = args.backend
+    cfg = get_config(args.config, **overrides)
+
+    trainer = Trainer(cfg)
+    test_ds = ASTDataset(cfg, "test", trainer.src_vocab, trainer.tgt_vocab)
+
+    if args.is_test:
+        from csat_tpu.train.checkpoint import restore_params
+
+        params = restore_params(args.checkpoint_dir or trainer.output_dir)
+        scores = run_test(
+            trainer.model, params, test_ds, cfg, trainer.tgt_vocab,
+            jax.random.key(cfg.seed), output_dir=trainer.output_dir,
+        )
+        print(json.dumps(scores))
+        return
+
+    train_ds = ASTDataset(cfg, "train", trainer.src_vocab, trainer.tgt_vocab)
+    val_ds = ASTDataset(cfg, "dev", trainer.src_vocab, trainer.tgt_vocab)
+
+    from csat_tpu.train.checkpoint import make_checkpoint_fn, save_params
+
+    ckpt_fn = make_checkpoint_fn(trainer.output_dir)
+    state, history = trainer.fit(train_ds, val_ds, checkpoint_fn=ckpt_fn)
+    # persist the best-by-val-BLEU weights (ref best_model file, train.py:200-208)
+    save_params(trainer.output_dir, history["best_params"])
+    scores = run_test(
+        trainer.model, history["best_params"], test_ds, cfg, trainer.tgt_vocab,
+        jax.random.key(cfg.seed), output_dir=trainer.output_dir,
+    )
+    print(json.dumps({"val_best_bleu": history["best_bleu"], **scores}))
+
+
+if __name__ == "__main__":
+    main()

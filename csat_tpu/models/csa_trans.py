@@ -1,0 +1,182 @@
+"""CSATrans: the full encoder–decoder model (flax.linen).
+
+Capability parity with ``/root/reference/module/csa_trans.py`` +
+``base_seq2seq.py``:
+
+* src embedding sized ``sbm_enc_dim - pe_dim``; tgt embedding with
+  sinusoidal positions (ref ``csa_trans.py:93-105``);
+* PE dispatch across the five variants (ref ``base_seq2seq.py:67-88``);
+* SBM encoder (``sbm.py``) consuming ``concat([src_emb, pe_expand(pe)])``;
+* decoder (depth ``decoder_layers``, reference hardcodes 4) + Generator;
+* sparsity aggregation: mean over layers, or 1.0 for full attention
+  (ref ``base_seq2seq.py:92-95``);
+* ``encode`` returns the post-expansion PE — the probe-visible tensor
+  (SURVEY §8.13).
+
+Decode paths:
+* ``__call__`` — teacher-forced training forward returning log-probs and the
+  sparsity scalar.
+* ``decode_step`` + ``init_cache`` — single-token decoding with a KV cache,
+  driven by ``lax.scan`` in ``csat_tpu/train/decode.py``. The reference
+  re-runs the full decoder on the growing prefix with no cache
+  (``base_seq2seq.py:128-145``); output-equivalent, asymptotically faster.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from flax import linen as nn
+
+from csat_tpu.configs import Config
+from csat_tpu.data.dataset import Batch
+from csat_tpu.models.components import (
+    Decoder,
+    Embeddings,
+    Generator,
+    make_std_mask,
+    subsequent_mask,
+)
+from csat_tpu.models.cse import CSE
+from csat_tpu.models.pe import TreePositionalEncodings, TripletEmbedding, laplacian_pe
+from csat_tpu.models.sbm import SBMEncoder
+from csat_tpu.utils import PAD
+
+Dtype = Any
+
+# reference hardcodes triplet vocab sizes per language (csa_trans.py:141-143);
+# used as fallback when no triplet dictionary is on disk
+TRIPLET_VOCAB_FALLBACK = {"python": 1246, "java": 1505}
+
+
+class CSATrans(nn.Module):
+    cfg: Config
+    src_vocab_size: int
+    tgt_vocab_size: int
+    triplet_vocab_size: int = 0
+    dtype: Dtype = jnp.float32
+
+    def setup(self):
+        cfg = self.cfg
+        self.src_embedding = Embeddings(
+            self.src_vocab_size, cfg.src_emb_dim, cfg.dropout, with_pos=False, dtype=self.dtype
+        )
+        self.tgt_embedding = Embeddings(
+            self.tgt_vocab_size, cfg.hidden_size, cfg.dropout, with_pos=True, dtype=self.dtype
+        )
+        if cfg.use_pegen == "pegen":
+            self.src_pe_embedding = Embeddings(
+                self.src_vocab_size, cfg.pegen_dim, cfg.dropout, with_pos=False, dtype=self.dtype
+            )
+            self.pegen = CSE(cfg, self.dtype)
+        elif cfg.use_pegen == "treepos":
+            self.tree_pos_enc = TreePositionalEncodings(
+                depth=cfg.tree_pos_height,
+                width=cfg.tree_pos_width,
+                n_feat=cfg.pegen_dim // (cfg.tree_pos_height * cfg.tree_pos_width),
+            )
+        elif cfg.use_pegen == "triplet":
+            size = self.triplet_vocab_size or TRIPLET_VOCAB_FALLBACK[cfg.lang]
+            self.triplet_emb = TripletEmbedding(size, cfg.pegen_dim, self.dtype)
+        self.encoder = SBMEncoder(cfg, self.dtype)
+        self.decoder = Decoder(
+            cfg.decoder_layers, cfg.hidden_size, cfg.num_heads, cfg.dim_feed_forward,
+            cfg.dropout, self.dtype,
+        )
+        self.generator = Generator(
+            self.tgt_vocab_size, cfg.dropout, reference_dropout=cfg.generator_dropout,
+        )
+
+    # ---------------- encoder ----------------
+
+    def encode(
+        self, batch: Batch, deterministic: bool = True, collect_aux: bool = False
+    ):
+        """→ (memory, sparsity_scalar, src_pe_expanded, graphs, attns)."""
+        cfg = self.cfg
+        src_mask = batch.src_seq == PAD  # (B, N) True = pad
+        src_emb = self.src_embedding(batch.src_seq, deterministic)
+
+        if cfg.use_pegen == "pegen":
+            pe_emb = self.src_pe_embedding(batch.src_seq, deterministic)
+            src_pe = self.pegen(
+                pe_emb, batch.L, batch.T, batch.L_mask, batch.T_mask, deterministic
+            )
+        elif cfg.use_pegen == "laplacian":
+            src_pe = laplacian_pe(batch.adj, batch.num_node, cfg.pegen_dim).astype(self.dtype)
+        elif cfg.use_pegen == "treepos":
+            src_pe = self.tree_pos_enc(batch.tree_pos.astype(jnp.float32)).astype(self.dtype)
+        elif cfg.use_pegen == "sequential":
+            src_pe = None
+        elif cfg.use_pegen == "triplet":
+            src_pe = self.triplet_emb(batch.triplet)
+        else:  # pragma: no cover
+            raise ValueError(cfg.use_pegen)
+
+        memory, sparsities, graphs, attns, pe = self.encoder(
+            src_emb, src_pe, src_mask, deterministic, collect_aux
+        )
+        if cfg.full_att:
+            sparsity = jnp.asarray(1.0, dtype=jnp.float32)
+        else:
+            sparsity = jnp.mean(jnp.stack([jnp.mean(s) for s in sparsities]))
+        return memory, sparsity, pe, graphs, attns
+
+    # ---------------- teacher-forced forward ----------------
+
+    def __call__(
+        self, batch: Batch, deterministic: bool = True, collect_aux: bool = False
+    ):
+        memory, sparsity, pe, graphs, attns = self.encode(batch, deterministic, collect_aux)
+        src_mask = batch.src_seq == PAD
+        tgt_mask = make_std_mask(batch.tgt_seq, PAD)
+        tgt_emb = self.tgt_embedding(batch.tgt_seq, deterministic)
+        dec_out, _ = self.decoder(
+            tgt_emb, memory, tgt_mask, src_mask, deterministic, cache=None
+        )
+        log_probs = self.generator(dec_out, deterministic)
+        return log_probs, sparsity, pe, graphs, attns
+
+    # ---------------- cached greedy decoding ----------------
+
+    def init_decode_cache(self, memory: jnp.ndarray, max_len: int) -> Dict[str, Any]:
+        """Per-layer cache: empty self-attn K/V buffers plus cross-attn K/V
+        projected from the (constant) encoder memory exactly once."""
+        cfg = self.cfg
+        b = memory.shape[0]
+        dh = cfg.hidden_size // cfg.num_heads
+        zeros = jnp.zeros((b, cfg.num_heads, max_len, dh), dtype=jnp.float32)
+        cache: Dict[str, Any] = {}
+        for i, layer in enumerate(self.decoder.layers):
+            cache[f"layer_{i}"] = {
+                "self": {"k": zeros, "v": zeros, "idx": jnp.asarray(0, jnp.int32)},
+                "cross": layer.cross_attn.project_kv(memory),
+            }
+        return cache
+
+    def decode_step(
+        self,
+        tok: jnp.ndarray,  # (B, 1) current input token
+        pos: jnp.ndarray,  # () int32 — its position
+        cache: Dict[str, Any],
+        memory: jnp.ndarray,
+        src_mask: jnp.ndarray,  # (B, N) bool
+        prev_pad: jnp.ndarray,  # (B, max_len) bool — pad flags of tokens so far
+    ) -> Tuple[jnp.ndarray, Dict[str, Any]]:
+        """One decoding step over the KV cache. Returns (log_probs_B_V, cache).
+
+        ``prev_pad`` reproduces the reference's ``make_std_mask(ys, 0)``
+        semantics exactly: a previously *generated* PAD token is masked out of
+        later self-attention (``base_seq2seq.py:137``).
+        """
+        max_len = prev_pad.shape[1]
+        emb = self.tgt_embedding(tok, deterministic=True, pos=pos)
+        future = jnp.arange(max_len)[None, None, :] > pos  # (1, 1, max_len)
+        step_mask = prev_pad[:, None, :] | future  # (B, 1, max_len)
+        dec_out, cache = self.decoder(
+            emb, memory, step_mask, src_mask, deterministic=True, cache=cache
+        )
+        log_probs = self.generator(dec_out[:, -1], deterministic=True)
+        return log_probs, cache

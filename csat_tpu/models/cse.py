@@ -1,0 +1,168 @@
+"""CSE — Code Structure Embedder: disentangled relative-position attention.
+
+Capability parity with ``/root/reference/module/csa_trans.py:180-236`` (CSE /
+CSE_layer) and ``module/disentangled_attn.py``:
+
+* learned relative-distance embedding tables ``L_q``/``T_q`` of shape
+  ``(max_src_len, pegen_dim)`` shared across layers (ref ``:190-191``);
+* the 8 attention "heads" are 4 L-heads + 4 T-heads: L distances are tiled
+  to pseudo-heads 0-3 and T to 4-7, with matching per-group projections of
+  the embedding tables (ref ``csa_trans.py:204-211``,
+  ``disentangled_attn.py:29-33``; SURVEY §8.4);
+* DeBERTa-style score assembly ``c2c + p2c + c2p`` where p2c/c2p are
+  relative-index gathers, scaled by ``sqrt(3·d_k)`` and masked with -1e9
+  where the raw distance was 0 — so self-pairs and unrelated pairs are
+  masked (ref ``disentangled_attn.py:44-65``; SURVEY §8.3);
+* pre-norm sublayers with FFN, final LayerNorm (ref ``CSE_layer``).
+
+The gathers are ``jnp.take_along_axis`` under XLA;
+``backend="pallas"`` routes score assembly + softmax through the fused
+Pallas kernel in ``csat_tpu/ops/cse_pallas.py``.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+from flax import linen as nn
+
+from csat_tpu.configs import Config
+from csat_tpu.models.components import LN_EPS, XAVIER, FeedForward, dense, merge_heads
+
+Dtype = Any
+
+
+def disentangled_scores(
+    q: jnp.ndarray,  # (B, H, N, dk)
+    k: jnp.ndarray,  # (B, H, N, dk)
+    lq: jnp.ndarray,  # (H, R, dk) — per-head projected relative table (queries)
+    lk: jnp.ndarray,  # (H, R, dk) — per-head projected relative table (keys)
+    rel: jnp.ndarray,  # (B, H, N, N) int32 — offset distances in [0, R)
+) -> jnp.ndarray:
+    """c2c + p2c + c2p score assembly (ref ``disentangled_attn.py:44-61``)."""
+    dk = q.shape[-1]
+    scale = math.sqrt(dk * 3)
+    c2c = jnp.einsum("bhnd,bhmd->bhnm", q, k) / scale
+    # p2c[b,h,i,j] = (lq · k_j)[rel[b,h,j,i]] — gather over the R axis
+    p2c_full = jnp.einsum("hrd,bhmd->bhrm", lq, k)  # (B, H, R, N)
+    p2c = jnp.take_along_axis(p2c_full, jnp.swapaxes(rel, -1, -2), axis=2) / scale
+    # c2p[b,h,i,j] = (q_i · lk)[rel[b,h,i,j]]
+    c2p_full = jnp.einsum("bhnd,hrd->bhnr", q, lk)  # (B, H, N, R)
+    c2p = jnp.take_along_axis(c2p_full, rel, axis=3) / scale
+    return c2c + p2c + c2p
+
+
+class DisentangledAttn(nn.Module):
+    """One disentangled-attention layer over precomputed rel indices/masks."""
+
+    cfg: Config
+    dtype: Dtype = jnp.float32
+
+    @nn.compact
+    def __call__(
+        self,
+        x: jnp.ndarray,  # (B, N, pegen_dim)
+        rel_tables: jnp.ndarray,  # (2, R, pegen_dim) — stacked L_q, T_q
+        rel: jnp.ndarray,  # (B, 8, N, N) int32
+        mask: jnp.ndarray,  # (B, 8, N, N) bool
+        deterministic: bool = True,
+    ) -> jnp.ndarray:
+        cfg = self.cfg
+        d = cfg.pegen_dim
+        h = cfg.num_heads
+        dk = d // h
+        half = h // 2  # 4 L-heads + 4 T-heads in the reference geometry
+
+        def heads(t, n_heads):
+            # (..., R, d) -> (n_heads, R, dk) for the rel tables
+            r = t.shape[0]
+            return t.reshape(r, n_heads, dk).transpose(1, 0, 2)
+
+        q = dense(d, self.dtype, name="wq")(x)
+        k = dense(d, self.dtype, name="wk")(x)
+        v = dense(d, self.dtype, name="wv")(x)
+        b, n, _ = x.shape
+        q, k, v = (
+            t.reshape(b, n, h, dk).transpose(0, 2, 1, 3).astype(jnp.float32)
+            for t in (q, k, v)
+        )
+
+        l_table, t_table = rel_tables[0], rel_tables[1]
+        lq = heads(dense(dk * half, self.dtype, name="l_q")(l_table), half)
+        lk = heads(dense(dk * half, self.dtype, name="l_k")(l_table), half)
+        tq = heads(dense(dk * half, self.dtype, name="t_q")(t_table), half)
+        tk = heads(dense(dk * half, self.dtype, name="t_k")(t_table), half)
+        rel_q = jnp.concatenate([lq, tq], axis=0).astype(jnp.float32)  # (8, R, dk)
+        rel_k = jnp.concatenate([lk, tk], axis=0).astype(jnp.float32)
+
+        if cfg.backend == "pallas":
+            from csat_tpu.ops.cse_pallas import disentangled_attention_pallas
+
+            out = disentangled_attention_pallas(q, k, v, rel_q, rel_k, rel, mask)
+        else:
+            scores = disentangled_scores(q, k, rel_q, rel_k, rel)
+            scores = jnp.where(mask, -1e9, scores)  # finite fill (ref :62)
+            attn = jax.nn.softmax(scores, axis=-1)
+            out = jnp.einsum("bhnm,bhmd->bhnd", attn, v)
+        out = merge_heads(out).astype(self.dtype)
+        return dense(d, self.dtype, name="wo")(out)
+
+
+class CSELayer(nn.Module):
+    """Pre-norm: disentangled attention + FFN (ref ``CSE_layer``)."""
+
+    cfg: Config
+    dtype: Dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, rel_tables, rel, mask, deterministic: bool = True):
+        cfg = self.cfg
+        h = nn.LayerNorm(epsilon=LN_EPS, dtype=self.dtype)(x)
+        h = DisentangledAttn(cfg, self.dtype)(h, rel_tables, rel, mask, deterministic)
+        x = x + nn.Dropout(cfg.dropout)(h, deterministic=deterministic)
+        h = nn.LayerNorm(epsilon=LN_EPS, dtype=self.dtype)(x)
+        h = FeedForward(cfg.pegen_dim, cfg.pegen_dim, cfg.dropout, self.dtype)(h, deterministic)
+        x = x + nn.Dropout(cfg.dropout)(h, deterministic=deterministic)
+        return x
+
+
+class CSE(nn.Module):
+    """Stack of CSE layers producing the per-node positional encoding
+    (ref ``CSE``, ``csa_trans.py:180-217``)."""
+
+    cfg: Config
+    dtype: Dtype = jnp.float32
+
+    @nn.compact
+    def __call__(
+        self,
+        src_pe_emb: jnp.ndarray,  # (B, N, pegen_dim)
+        L: jnp.ndarray,  # (B, N, N) int32 — offset distances
+        T: jnp.ndarray,
+        L_mask: jnp.ndarray,  # (B, N, N) bool — raw distance == 0
+        T_mask: jnp.ndarray,
+        deterministic: bool = True,
+    ) -> jnp.ndarray:
+        cfg = self.cfg
+        half = cfg.num_heads // 2
+        rel = jnp.concatenate(
+            [jnp.repeat(L[:, None], half, axis=1), jnp.repeat(T[:, None], half, axis=1)],
+            axis=1,
+        ).astype(jnp.int32)
+        mask = jnp.concatenate(
+            [jnp.repeat(L_mask[:, None], half, axis=1), jnp.repeat(T_mask[:, None], half, axis=1)],
+            axis=1,
+        )
+        l_q = self.param("L_q", XAVIER, (cfg.max_src_len, cfg.pegen_dim))
+        t_q = self.param("T_q", XAVIER, (cfg.max_src_len, cfg.pegen_dim))
+        rel_tables = jnp.stack([l_q, t_q]).astype(self.dtype)
+
+        x = src_pe_emb
+        for i in range(cfg.num_layers):
+            x = CSELayer(cfg, self.dtype, name=f"layer_{i}")(
+                x, rel_tables, rel, mask, deterministic
+            )
+        return nn.LayerNorm(epsilon=LN_EPS, dtype=self.dtype)(x)

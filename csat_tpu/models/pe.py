@@ -1,0 +1,95 @@
+"""Alternative positional-encoding variants: treepos, laplacian, triplet.
+
+* ``TreePositionalEncodings`` — Shiv & Quirk (NeurIPS'19) style learnable
+  geometric-decay tree encodings (ref ``module/csa_trans.py:19-64``).
+* ``laplacian_pe`` — graph-Laplacian eigenvector PE. The reference runs a
+  **per-sample Python loop of numpy ``eigh`` calls on CPU** with explicit
+  GPU→CPU→GPU transfers (``module/base_seq2seq.py:12-36,70-82``); here it is
+  one batched ``jnp.linalg.eigh`` on padded adjacencies, fully on-device
+  under ``jit`` — the designated ``python_lap`` north-star config.
+* ``triplet`` — an ``nn.Embed`` over node-triplet ids; vocab size comes from
+  the triplet dictionary rather than the reference's hardcoded 1246/1505
+  (``csa_trans.py:141-143``).
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+from flax import linen as nn
+
+Dtype = Any
+
+
+class TreePositionalEncodings(nn.Module):
+    """positions (B, N, depth*width) → (B, N, depth*width*n_feat)."""
+
+    depth: int  # max tree depth (16)
+    width: int  # max degree (8)
+    n_feat: int  # features per (depth, width) slot
+
+    @nn.compact
+    def __call__(self, positions: jnp.ndarray) -> jnp.ndarray:
+        d_tree_param = self.n_feat
+        d_pos = self.n_feat * self.depth * self.width
+        d_model = d_pos
+        p = self.param(
+            "p",
+            lambda key, shape: jax.random.uniform(key, shape, minval=0.7, maxval=0.999),
+            (d_tree_param,),
+        )
+        tree_params = jnp.tanh(p)  # (n_feat,)
+        tiled = jnp.broadcast_to(tree_params, (self.depth, self.width, d_tree_param))
+        depths = jnp.arange(self.depth, dtype=jnp.float32)[:, None, None]
+        norm = jnp.sqrt((1.0 - jnp.square(tree_params)) * d_model / 2.0)
+        weights = (jnp.power(tiled, depths) * norm).reshape(self.depth * self.width, d_tree_param)
+        treeified = positions[..., None] * weights  # (B, N, D*W, n_feat)
+        return treeified.reshape(positions.shape[:-1] + (d_pos,))
+
+
+def laplacian_pe(adj: jnp.ndarray, num_node: jnp.ndarray, pegen_dim: int) -> jnp.ndarray:
+    """Batched symmetric-normalized-Laplacian eigenvectors.
+
+    ``adj``: (B, N, N) float — the |L|≤1 pseudo-adjacency (quirk §8.5);
+    ``num_node``: (B,) — valid node counts. Matches the reference semantics
+    of eigendecomposing the ``[:n, :n]`` slice: padding rows/cols are
+    replaced by a large-eigenvalue identity block so the real spectrum
+    (normalized-Laplacian eigenvalues ≤ 2) sorts strictly first, then pad
+    rows/cols of the eigenvector matrix are zeroed. Output is zero-padded to
+    ``(B, N, pegen_dim)``.
+
+    Eigenvector sign/order within degenerate eigenvalues is basis-arbitrary
+    (true of the numpy original as well), so parity is up-to-sign.
+    """
+    b, n, _ = adj.shape
+    valid = jnp.arange(n)[None, :] < num_node[:, None]  # (B, N)
+    pair = valid[:, :, None] & valid[:, None, :]
+    a = jnp.where(pair, adj.astype(jnp.float32), 0.0)
+    deg = jnp.sum(a, axis=-1)
+    dinv = jnp.where(valid, jnp.clip(deg, 1.0, None) ** -0.5, 0.0)
+    lap = jnp.eye(n)[None] * valid[:, None, :] - dinv[:, :, None] * a * dinv[:, None, :]
+    # pad block: large identity so its eigenvalues sort last
+    big = 1e3
+    pad_diag = jnp.eye(n)[None] * (~valid[:, None, :]) * big
+    lap = lap + pad_diag
+    _, vecs = jnp.linalg.eigh(lap)  # ascending eigenvalues; (B, N, N) columns
+    vecs = jnp.where(pair, vecs, 0.0)  # zero pad rows and pad-eigvec columns
+    out = jnp.zeros((b, n, pegen_dim), dtype=jnp.float32)
+    return out.at[:, :, :n].set(vecs)
+
+
+class TripletEmbedding(nn.Module):
+    """Embedding over node-triplet ids (ref ``csa_trans.py:139-143``)."""
+
+    vocab_size: int
+    pegen_dim: int
+    dtype: Dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, triplet: jnp.ndarray) -> jnp.ndarray:
+        table = self.param(
+            "embedding", nn.initializers.xavier_uniform(), (self.vocab_size, self.pegen_dim)
+        )
+        return jnp.take(table, triplet, axis=0).astype(self.dtype)

@@ -1,0 +1,225 @@
+"""SBM encoder: stochastic-block-model sparse attention (flax.linen).
+
+Capability parity with ``/root/reference/module/sbm_model.py`` and
+``sbm_attn.py``:
+
+* per-layer, per-head learnable cluster embeddings, orthogonally initialized
+  (ref ``csa_trans.py:170-175``);
+* cluster affinity ``S = softmax_k²(C Cᵀ)``, soft memberships
+  ``Q̂ = σ(proj(Q) Cᵀ)``, expected adjacency ``expA = Q̂ S K̂ᵀ``
+  (ref ``sbm_attn.py:38-55``);
+* a Bernoulli 0/1 graph sampled from ``expA`` with a straight-through
+  gradient (``ste.py``), multiplied into the padded softmax attention and
+  L1-renormalized (ref ``sbm_attn.py:57-63``);
+* per-head sparsity ``Σgraph/(b·n·m)`` collected per layer and averaged into
+  the training loss by the harness (ref ``sbm_attn.py:64``,
+  ``train.py:109``);
+* the whole attention body runs in fp32 regardless of the compute dtype —
+  the XLA analogue of the reference's ``autocast(enabled=False)`` island
+  (``sbm_attn.py:120-126``);
+* ``FullAttention`` variant (``full_att=True`` configs) = plain masked
+  softmax, sparsity 1 (ref ``sbm_attn.py:69-87``);
+* encoder blocks are pre-norm MHA + GELU MLP with residuals; the final
+  LayerNorm output is zeroed at padded positions *after* normalization
+  (quirk, ref ``sbm_model.py:68``, SURVEY §8.11) and projected
+  ``sbm_enc_dim → hidden_size``.
+
+The ``backend="pallas"`` switch routes the attention inner loop through the
+fused Pallas TPU kernel in ``csat_tpu/ops/sbm_pallas.py``.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Any, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from flax import linen as nn
+
+from csat_tpu.configs import Config
+from csat_tpu.models.components import LN_EPS, XAVIER, dense, merge_heads, sinusoidal_table, split_heads
+from csat_tpu.models.ste import bernoulli_noise, sample_graph
+
+Dtype = Any
+
+
+def l1_normalize(x: jnp.ndarray, axis: int = -1, eps: float = 1e-12) -> jnp.ndarray:
+    """torch ``F.normalize(p=1)``: divide by max(‖x‖₁, eps)."""
+    norm = jnp.sum(jnp.abs(x), axis=axis, keepdims=True)
+    return x / jnp.maximum(norm, eps)
+
+
+class ClusterProj(nn.Module):
+    """3-layer MLP applied to Q and K head vectors (ref ``sbm_attn.py:22-30``)."""
+
+    head_dim: int
+    dropout: float = 0.2
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray, deterministic: bool = True) -> jnp.ndarray:
+        h = dense(self.head_dim)(x)
+        h = nn.Dropout(self.dropout)(h, deterministic=deterministic)
+        h = nn.relu(h)
+        h = dense(self.head_dim)(h)
+        h = nn.Dropout(self.dropout)(h, deterministic=deterministic)
+        h = nn.relu(h)
+        return dense(self.head_dim)(h)
+
+
+class SBMAttention(nn.Module):
+    """Sampled block-sparse attention core. Returns (out, sparsity, graph, attn)."""
+
+    num_heads: int
+    head_dim: int
+    num_clusters: int
+    attention_dropout: float
+    backend: str = "xla"
+
+    @nn.compact
+    def __call__(
+        self,
+        q: jnp.ndarray,  # (B, H, N, dh) — fp32
+        k: jnp.ndarray,
+        v: jnp.ndarray,
+        key_pad: jnp.ndarray,  # (B, N) bool/float, truthy = padded
+        deterministic: bool = True,
+    ) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+        b, h, n, dh = q.shape
+        kk = self.num_clusters
+        clusters = self.param(
+            "clusters", nn.initializers.orthogonal(), (h * kk, dh)
+        ).reshape(h, kk, dh)
+
+        # S: softmax over the flattened k² affinity matrix, per head
+        dist = jnp.einsum("hkd,hjd->hkj", clusters, clusters)
+        s = jax.nn.softmax(dist.reshape(h, kk * kk), axis=-1).reshape(h, kk, kk)
+
+        proj = ClusterProj(dh)
+        q_hat = jax.nn.sigmoid(jnp.einsum("bhnd,hkd->bhnk", proj(q, deterministic), clusters))
+        k_hat = jax.nn.sigmoid(jnp.einsum("bhnd,hkd->bhnk", proj(k, deterministic), clusters))
+        exp_a = jnp.einsum("bhnk,hkj,bhmj->bhnm", q_hat, s, k_hat)
+
+        noise = bernoulli_noise(self.make_rng("sample"), exp_a.shape)
+        graph = sample_graph(exp_a, noise)
+
+        mask = key_pad[:, None, None, :].astype(bool)
+        if self.backend == "pallas":
+            from csat_tpu.ops.sbm_pallas import sbm_attention_pallas
+
+            out, attn = sbm_attention_pallas(q, k, v, graph, key_pad)
+        else:
+            dot = jnp.einsum("bhnd,bhmd->bhnm", q, k) / math.sqrt(dh)
+            dot = jnp.where(mask, -jnp.inf, dot)
+            attn = l1_normalize(jax.nn.softmax(dot, axis=-1) * graph)
+            attn_d = nn.Dropout(self.attention_dropout)(attn, deterministic=deterministic)
+            out = jnp.einsum("bhnm,bhmd->bhnd", attn_d, v)
+        sparsity = jnp.sum(graph, axis=(0, 2, 3)) / (b * n * n)  # (H,)
+        return out, sparsity, graph, attn
+
+
+class FullAttention(nn.Module):
+    """Dense masked softmax attention (ref ``sbm_attn.py:69-87``)."""
+
+    head_dim: int
+    attention_dropout: float
+
+    @nn.compact
+    def __call__(self, q, k, v, key_pad, deterministic: bool = True):
+        mask = key_pad[:, None, None, :].astype(bool)
+        dot = jnp.einsum("bhnd,bhmd->bhnm", q, k) / math.sqrt(self.head_dim)
+        dot = jnp.where(mask, -jnp.inf, dot)
+        attn = l1_normalize(jax.nn.softmax(dot, axis=-1))
+        attn_d = nn.Dropout(self.attention_dropout)(attn, deterministic=deterministic)
+        out = jnp.einsum("bhnm,bhmd->bhnd", attn_d, v)
+        return out, None, mask, attn
+
+
+class SBMBlock(nn.Module):
+    """Pre-norm transformer block around the (SBM|Full) attention
+    (ref ``sbm_model.py:10-31`` + projection wrapper ``sbm_attn.py:90-140``)."""
+
+    cfg: Config
+    layer_idx: int
+    dtype: Dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, key_pad, deterministic: bool = True):
+        cfg = self.cfg
+        d = cfg.sbm_enc_dim
+        h = nn.LayerNorm(epsilon=LN_EPS, dtype=self.dtype)(x)
+        q = split_heads(dense(d, self.dtype, name="wq")(h), cfg.num_heads)
+        k = split_heads(dense(d, self.dtype, name="wk")(h), cfg.num_heads)
+        v = split_heads(dense(d, self.dtype, name="wv")(h), cfg.num_heads)
+        # fp32 attention island (ref sbm_attn.py:120-126)
+        q, k, v = (t.astype(jnp.float32) for t in (q, k, v))
+        if cfg.full_att:
+            attn_out, sparsity, graph, attn = FullAttention(
+                cfg.head_dim, cfg.attention_dropout
+            )(q, k, v, key_pad, deterministic)
+        else:
+            attn_out, sparsity, graph, attn = SBMAttention(
+                cfg.num_heads,
+                cfg.head_dim,
+                cfg.clusters[self.layer_idx],
+                cfg.attention_dropout,
+                backend=cfg.backend,
+            )(q, k, v, key_pad, deterministic)
+        attn_out = dense(d, self.dtype, name="wo")(merge_heads(attn_out).astype(self.dtype))
+        x = x + nn.Dropout(cfg.dropout)(attn_out, deterministic=deterministic)
+
+        h = nn.LayerNorm(epsilon=LN_EPS, dtype=self.dtype)(x)
+        h = dense(d, self.dtype)(h)
+        h = nn.gelu(h, approximate=False)
+        h = nn.Dropout(cfg.dropout)(h, deterministic=deterministic)
+        h = dense(d, self.dtype)(h)
+        h = nn.Dropout(cfg.dropout)(h, deterministic=deterministic)
+        x = x + h
+        return x, sparsity, graph, attn
+
+
+class SBMEncoder(nn.Module):
+    """The main encoder (ref ``SBM``, ``sbm_model.py:34-70``).
+
+    For PE-carrying variants, the per-node PE is projected
+    ``pegen_dim → pe_dim`` and concatenated with the token embedding; the
+    ``sequential`` variant instead adds a sinusoidal PE to the embedding.
+    Returns ``(X, sparsities, graphs, attns, pe)`` where ``pe`` is the
+    post-expansion PE — the tensor the probe experiments consume
+    (SURVEY §8.13).
+    """
+
+    cfg: Config
+    dtype: Dtype = jnp.float32
+
+    @nn.compact
+    def __call__(
+        self,
+        src_emb: jnp.ndarray,  # (B, N, src_emb_dim)
+        src_pe: Optional[jnp.ndarray],  # (B, N, pegen_dim) or None
+        key_pad: jnp.ndarray,  # (B, N) bool
+        deterministic: bool = True,
+        collect_aux: bool = False,
+    ):
+        cfg = self.cfg
+        if cfg.use_pegen == "sequential":
+            pe = None
+            x = src_emb + sinusoidal_table(cfg.max_src_len, cfg.sbm_enc_dim)[None].astype(self.dtype)
+        else:
+            pe = dense(cfg.pe_dim, self.dtype, name="pe_expand")(src_pe)
+            x = jnp.concatenate([src_emb, pe], axis=-1)
+
+        sparsities: List[jnp.ndarray] = []
+        graphs, attns = [], []
+        for i in range(cfg.sbm_layers):
+            x, sparsity, graph, attn = SBMBlock(cfg, i, self.dtype, name=f"transformer_{i}")(
+                x, key_pad, deterministic
+            )
+            sparsities.append(sparsity)
+            if collect_aux:
+                graphs.append(graph)
+                attns.append(attn)
+        x = nn.LayerNorm(epsilon=LN_EPS, dtype=self.dtype)(x)
+        x = x * (1.0 - key_pad.astype(x.dtype))[:, :, None]  # zero pads post-norm (quirk §8.11)
+        x = dense(cfg.hidden_size, self.dtype, name="out")(x)
+        return x, sparsities, graphs, attns, pe

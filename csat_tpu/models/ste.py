@@ -1,0 +1,46 @@
+"""Straight-through Bernoulli graph sampler.
+
+Capability parity with ``/root/reference/module/STE.py``: forward samples a
+0/1 mask ``A ~ Bernoulli(clamp(expA, 0.01, 0.99))``; backward is the
+straight-through estimator gated by the sample, ``hardtanh(A * grad)``.
+
+The torch version leans on global stateful RNG; under JAX the randomness is
+explicit — the caller threads a PRNG key in, and the uniform noise enters as
+an argument so the ``custom_vjp`` sees a pure function. This makes the
+sampler correct under ``jit``/``vmap``/``grad``/``shard_map`` by
+construction, which the reference gets only informally.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["sample_graph", "bernoulli_noise"]
+
+
+def bernoulli_noise(key: jax.Array, shape) -> jnp.ndarray:
+    """Uniform(0,1) noise used by :func:`sample_graph`."""
+    return jax.random.uniform(key, shape, dtype=jnp.float32)
+
+
+@jax.custom_vjp
+def sample_graph(exp_a: jnp.ndarray, noise: jnp.ndarray) -> jnp.ndarray:
+    """A = 1{noise < clamp(expA, .01, .99)} — Bernoulli(p) given uniform noise
+    (ref ``STE.py:10-15``)."""
+    p = jnp.clip(exp_a, 0.01, 0.99)
+    return (noise < p).astype(exp_a.dtype)
+
+
+def _fwd(exp_a, noise):
+    a = sample_graph(exp_a, noise)
+    return a, a
+
+
+def _bwd(a, g):
+    # hardtanh(A * grad): gradient flows only through sampled-on entries,
+    # clipped to [-1, 1] (ref ``STE.py:17-19``)
+    return jnp.clip(a * g, -1.0, 1.0), None
+
+
+sample_graph.defvjp(_fwd, _bwd)

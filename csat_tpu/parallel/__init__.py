@@ -1,0 +1,7 @@
+from csat_tpu.parallel.mesh import (  # noqa: F401
+    batch_sharding,
+    build_mesh,
+    param_sharding,
+    replicated,
+    shard_batch,
+)

@@ -1,0 +1,115 @@
+"""Device mesh + sharding rules: the TPU-native distributed backend.
+
+The reference's only parallelism is single-node data-parallel DDP over NCCL
+(``/root/reference/script/train.py:331``, SURVEY §2.3), with gradient
+allreduce hidden inside ``loss.backward()``. Here distribution is expressed
+the XLA way: a named :class:`jax.sharding.Mesh` over all devices with
+
+* ``data`` axis — batch sharding (DP). Gradient allreduce becomes a
+  compiler-inserted ``psum`` over ICI when the jitted train step consumes a
+  batch sharded on ``data`` and replicated params.
+* ``model`` axis — tensor parallelism for the wide matmuls: attention
+  QKV/output projections are sharded on the head dimension and the FFN on
+  its hidden dimension, following the Megatron column/row pattern. XLA
+  inserts the matching all-reduces.
+
+Multi-host: ``jax.distributed.initialize`` + per-host data sharding
+(``iterate_batches(num_shards=jax.process_count(), ...)``) extend the same
+mesh over DCN; nothing in the train step changes.
+
+Param partition rules are expressed as regex → PartitionSpec over the flax
+param path, resolved by :func:`param_sharding`.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Any, Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from csat_tpu.data.dataset import Batch
+
+__all__ = [
+    "build_mesh",
+    "batch_sharding",
+    "param_sharding",
+    "replicated",
+    "shard_batch",
+    "PARAM_RULES",
+]
+
+
+def build_mesh(
+    mesh_shape: Sequence[Tuple[str, int]] = (("data", -1),),
+    devices: Optional[Sequence[jax.Device]] = None,
+) -> Mesh:
+    """Create a named mesh. An axis size of -1 absorbs the remaining devices."""
+    devices = list(devices if devices is not None else jax.devices())
+    names = [n for n, _ in mesh_shape]
+    sizes = [s for _, s in mesh_shape]
+    if -1 in sizes:
+        fixed = int(np.prod([s for s in sizes if s != -1]))
+        sizes[sizes.index(-1)] = len(devices) // fixed
+    total = int(np.prod(sizes))
+    assert total <= len(devices), (sizes, len(devices))
+    arr = np.asarray(devices[:total]).reshape(sizes)
+    return Mesh(arr, axis_names=tuple(names))
+
+
+# flax param-path regex → PartitionSpec. First match wins; default replicated.
+# Layout (Megatron column/row pattern): attention q/k/v projections sharded on
+# the output (head) dim, out-projections on their input dim; FFN first dense
+# column-sharded, second row-sharded. Embedding tables are sharded on the
+# feature axis (vocab sizes are not generally divisible by the TP degree).
+PARAM_RULES: Tuple[Tuple[str, P], ...] = (
+    (r".*/(wq|wk|wv|q|k|v)/kernel$", P(None, "model")),
+    (r".*/(wo|out)/kernel$", P("model", None)),
+    (r".*(/ff|FeedForward_\d+)/Dense_0/kernel$", P(None, "model")),
+    (r".*(/ff|FeedForward_\d+)/Dense_1/kernel$", P("model", None)),
+    (r".*transformer_\d+/Dense_0/kernel$", P(None, "model")),  # encoder MLP up
+    (r".*transformer_\d+/Dense_1/kernel$", P("model", None)),  # encoder MLP down
+    (r".*generator/Dense_0/kernel$", P("model", None)),  # row-parallel head
+    (r".*embedding$", P(None, "model")),
+)
+
+
+def _spec_for(path: str, mesh: Mesh) -> P:
+    if "model" not in mesh.axis_names or mesh.shape.get("model", 1) == 1:
+        return P()
+    for pattern, spec in PARAM_RULES:
+        if re.match(pattern, path):
+            return spec
+    return P()
+
+
+def param_sharding(params: Any, mesh: Mesh) -> Any:
+    """Pytree of NamedShardings for the param tree (TP on the ``model`` axis;
+    fully replicated when the mesh has no/unit ``model`` axis)."""
+    flat = jax.tree_util.tree_flatten_with_path(params)[0]
+
+    def path_str(kp):
+        return "/".join(str(getattr(k, "key", getattr(k, "idx", k))) for k in kp)
+
+    specs = {path_str(kp): _spec_for(path_str(kp), mesh) for kp, _ in flat}
+
+    def to_sharding(kp, _leaf):
+        return NamedSharding(mesh, specs[path_str(kp)])
+
+    return jax.tree_util.tree_map_with_path(to_sharding, params)
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())
+
+
+def batch_sharding(mesh: Mesh) -> NamedSharding:
+    """Shard the leading (batch) dim over the ``data`` axis."""
+    return NamedSharding(mesh, P("data"))
+
+
+def shard_batch(batch: Batch, mesh: Mesh) -> Batch:
+    sh = batch_sharding(mesh)
+    return jax.tree.map(lambda x: jax.device_put(x, sh), batch)

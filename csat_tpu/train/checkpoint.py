@@ -1,0 +1,85 @@
+"""Checkpointing with orbax: full train-state save + resume.
+
+The reference saves model ``state_dict`` snapshots only — no optimizer,
+scheduler, or RNG state, so training cannot resume
+(``/root/reference/script/train.py:194-208``; SURVEY §5). Here the entire
+:class:`TrainState` pytree (params, AdamW moments, PRNG key, step) is
+checkpointed, plus a lightweight best-params snapshot mirroring the
+reference's best-by-val-BLEU file.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Callable, Optional
+
+import jax
+import numpy as np
+import orbax.checkpoint as ocp
+
+from csat_tpu.train.state import TrainState
+
+__all__ = ["save_state", "restore_state", "save_params", "restore_params", "make_checkpoint_fn"]
+
+
+def _mgr(directory: str) -> ocp.CheckpointManager:
+    return ocp.CheckpointManager(
+        os.path.abspath(directory),
+        options=ocp.CheckpointManagerOptions(max_to_keep=3, create=True),
+    )
+
+
+def _to_host(tree: Any) -> Any:
+    # orbax handles jax arrays, but raw PRNG keys need wrapping; store key data
+    return jax.tree.map(
+        lambda x: jax.random.key_data(x) if jax.dtypes.issubdtype(getattr(x, "dtype", None), jax.dtypes.prng_key) else np.asarray(x),
+        tree,
+    )
+
+
+def save_state(directory: str, state: TrainState, step: int) -> None:
+    mgr = _mgr(directory)
+    host_state = _to_host(state)
+    mgr.save(step, args=ocp.args.StandardSave(host_state))
+    mgr.wait_until_finished()
+    mgr.close()
+
+
+def restore_state(directory: str, example: TrainState, step: Optional[int] = None) -> TrainState:
+    """Restore into the structure of ``example`` (params/opt_state shapes must
+    match). The stored PRNG key data is rewrapped into a typed key."""
+    mgr = _mgr(directory)
+    step = step if step is not None else mgr.latest_step()
+    assert step is not None, f"no checkpoints under {directory}"
+    host_example = _to_host(example)
+    restored = mgr.restore(step, args=ocp.args.StandardRestore(host_example))
+    mgr.close()
+    rng = jax.random.wrap_key_data(restored.rng)
+    return TrainState(
+        step=restored.step, params=restored.params, opt_state=restored.opt_state, rng=rng
+    )
+
+
+def save_params(directory: str, params: Any, name: str = "best_model") -> None:
+    path = os.path.abspath(os.path.join(directory, name))
+    ckptr = ocp.StandardCheckpointer()
+    ckptr.save(path, jax.tree.map(np.asarray, params), force=True)
+    ckptr.wait_until_finished()
+
+
+def restore_params(directory: str, name: str = "best_model") -> Any:
+    path = os.path.abspath(os.path.join(directory, name))
+    if not os.path.exists(path):
+        raise FileNotFoundError(f"no saved params at {path}")
+    ckptr = ocp.StandardCheckpointer()
+    return ckptr.restore(path)
+
+
+def make_checkpoint_fn(directory: str) -> Callable[[TrainState, int], None]:
+    """Periodic-save hook for ``Trainer.fit`` (ref epoch snapshots,
+    ``train.py:194-198``)."""
+
+    def fn(state: TrainState, epoch: int) -> None:
+        save_state(os.path.join(directory, "checkpoints"), state, epoch)
+
+    return fn

@@ -1,0 +1,58 @@
+"""AdamW with optional bias correction, as an optax transformation.
+
+The reference vendors HuggingFace's AdamW and runs it with
+``correct_bias=False`` (BERT-style, no bias correction; decoupled weight
+decay applied after the adaptive step) — ``/root/reference/script/optimizer.py:49-106``,
+``script/train.py:80``. ``optax.adamw`` always bias-corrects, so the exact
+update is implemented here: ``p ← p − lr·(m̂/(√v̂+eps) + wd·p)`` with
+``m̂, v̂`` the *uncorrected* first/second moments when ``correct_bias=False``.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+import optax
+
+__all__ = ["adamw"]
+
+
+class AdamWState(NamedTuple):
+    count: jnp.ndarray
+    mu: optax.Updates
+    nu: optax.Updates
+
+
+def adamw(
+    learning_rate: float,
+    b1: float = 0.9,
+    b2: float = 0.999,
+    eps: float = 1e-6,
+    weight_decay: float = 0.0,
+    correct_bias: bool = False,
+) -> optax.GradientTransformation:
+    def init_fn(params):
+        return AdamWState(
+            count=jnp.zeros([], jnp.int32),
+            mu=jax.tree.map(jnp.zeros_like, params),
+            nu=jax.tree.map(jnp.zeros_like, params),
+        )
+
+    def update_fn(updates, state, params=None):
+        mu = jax.tree.map(lambda m, g: b1 * m + (1 - b1) * g, state.mu, updates)
+        nu = jax.tree.map(lambda v, g: b2 * v + (1 - b2) * (g * g), state.nu, updates)
+        count = state.count + 1
+        if correct_bias:
+            c1 = 1 - b1 ** count.astype(jnp.float32)
+            c2 = 1 - b2 ** count.astype(jnp.float32)
+            step = jax.tree.map(lambda m, v: (m / c1) / (jnp.sqrt(v / c2) + eps), mu, nu)
+        else:
+            step = jax.tree.map(lambda m, v: m / (jnp.sqrt(v) + eps), mu, nu)
+        if weight_decay > 0 and params is not None:
+            step = jax.tree.map(lambda s, p: s + weight_decay * p, step, params)
+        new_updates = jax.tree.map(lambda s: -learning_rate * s, step)
+        return new_updates, AdamWState(count=count, mu=mu, nu=nu)
+
+    return optax.GradientTransformation(init_fn, update_fn)

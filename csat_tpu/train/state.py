@@ -1,0 +1,62 @@
+"""Train state: params + optimizer state + PRNG key + step counter.
+
+A single pytree checkpointable by orbax in full — giving the resume
+capability the reference lacks (it saves model weights only,
+``script/train.py:194-198``; SURVEY §5 checkpoint/resume row).
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import optax
+from flax import struct
+
+from csat_tpu.configs import Config
+from csat_tpu.data.dataset import Batch
+from csat_tpu.models import CSATrans
+from csat_tpu.train.optimizer import adamw
+
+__all__ = ["TrainState", "create_train_state", "make_model"]
+
+
+class TrainState(struct.PyTreeNode):
+    step: jnp.ndarray
+    params: Any
+    opt_state: Any
+    rng: jax.Array
+
+    def replace_(self, **kw):
+        return self.replace(**kw)
+
+
+def make_model(cfg: Config, src_vocab_size: int, tgt_vocab_size: int, triplet_vocab_size: int = 0) -> CSATrans:
+    dtype = jnp.bfloat16 if cfg.compute_dtype == "bfloat16" else jnp.float32
+    return CSATrans(
+        cfg,
+        src_vocab_size=src_vocab_size,
+        tgt_vocab_size=tgt_vocab_size,
+        triplet_vocab_size=triplet_vocab_size,
+        dtype=dtype,
+    )
+
+
+def create_train_state(
+    model: CSATrans, tx: optax.GradientTransformation, example_batch: Batch, seed: int
+) -> TrainState:
+    rng = jax.random.key(seed)
+    rng, init_rng, sample_rng = jax.random.split(rng, 3)
+    variables = model.init({"params": init_rng, "sample": sample_rng}, example_batch)
+    params = variables["params"]
+    return TrainState(
+        step=jnp.zeros([], jnp.int32),
+        params=params,
+        opt_state=tx.init(params),
+        rng=rng,
+    )
+
+
+def default_optimizer(cfg: Config) -> optax.GradientTransformation:
+    return adamw(cfg.learning_rate, eps=1e-6, weight_decay=0.0, correct_bias=False)

@@ -1,0 +1,94 @@
+"""Metric correctness: BLEU against known values, ROUGE-L, METEOR, transform."""
+
+import numpy as np
+import pytest
+
+from csat_tpu.metrics import (
+    Meteor,
+    Rouge,
+    bleu_output_transform,
+    compute_bleu,
+    corpus_bleu,
+    eval_accuracies,
+    meteor_score,
+    sentence_bleu,
+)
+from csat_tpu.utils import EOS, PAD
+
+
+def test_bleu_perfect_match():
+    ref = "the cat sat on the mat".split()
+    bleu, precisions, bp, ratio, _, _ = compute_bleu([[ref]], [ref], smooth=False)
+    assert bleu == pytest.approx(1.0)
+    assert bp == 1.0 and ratio == 1.0
+    assert all(p == 1.0 for p in precisions)
+
+
+def test_bleu_known_value():
+    # hand-computable: hyp shares 3/4 unigrams, 1/3 bigrams with ref, no tri+
+    ref = "a b c d".split()
+    hyp = "a b x d".split()
+    bleu, precisions, bp, *_ = compute_bleu([[ref]], [hyp], smooth=True)
+    # smoothed precisions: (3+1)/(4+1), (1+1)/(3+1), (0+1)/(2+1), (0+1)/(1+1)
+    np.testing.assert_allclose(precisions, [4 / 5, 2 / 4, 1 / 3, 1 / 2], rtol=1e-9)
+    expected = (4 / 5 * 2 / 4 * 1 / 3 * 1 / 2) ** 0.25  # bp = 1 (equal length)
+    assert bleu == pytest.approx(expected)
+
+
+def test_brevity_penalty():
+    ref = "a b c d e f".split()
+    hyp = "a b c".split()
+    _, _, bp, ratio, hyp_len, ref_len = compute_bleu([[ref]], [hyp], smooth=True)
+    assert ratio == pytest.approx(0.5)
+    assert bp == pytest.approx(np.exp(1 - 2.0))
+
+
+def test_corpus_bleu_surface():
+    hyps = {0: ["the cat sat"], 1: ["dogs run fast"]}
+    refs = {0: ["the cat sat"], 1: ["dogs run quickly"]}
+    corpus, avg, ind = corpus_bleu(hyps, refs)
+    assert 0 < corpus <= 1 and 0 < avg <= 1
+    assert set(ind) == {0, 1}
+    assert ind[0] > ind[1]
+
+
+def test_rouge_l():
+    r = Rouge()
+    # identical → 1.0
+    assert r.calc_score(["a b c"], ["a b c"]) == pytest.approx(1.0)
+    # known LCS: hyp "a b d", ref "a c b" → LCS=2, P=2/3, R=2/3
+    p = rec = 2 / 3
+    beta = 1.2
+    expected = (1 + beta**2) * p * rec / (rec + beta**2 * p)
+    assert r.calc_score(["a b d"], ["a c b"]) == pytest.approx(expected)
+    mean, arr = r.compute_score({0: ["a b c"]}, {0: ["a b c"]})
+    assert mean == pytest.approx(1.0) and arr.shape == (1,)
+
+
+def test_meteor():
+    assert meteor_score("a b c".split(), "a b c".split()) == pytest.approx(0.5 * 2 * (1 - 0.5 * (1 / 3) ** 3) + 0.0, abs=1.0)
+    # perfect match: P=R=1, Fmean=1, chunks=1, penalty=0.5/m³-scaled
+    m = meteor_score(["x", "y", "z"], ["x", "y", "z"])
+    assert m == pytest.approx(1.0 * (1 - 0.5 * (1 / 3) ** 3))
+    assert meteor_score(["a"], ["b"]) == 0.0
+    mean, arr = Meteor().compute_score({0: ["x y"]}, {0: ["x y"]})
+    assert mean > 0.9
+
+
+def test_output_transform_edges():
+    i2w = {0: "<pad>", 1: "<unk>", 2: "<s>", 3: "</s>", 4: "cat", 5: "dog"}
+    y_pred = np.array([[4, 5, 3, 4], [3, 4, 5, 4], [4, 4, 4, 4]])
+    y = np.array([[4, 3, 0, 0], [5, 4, 3, 0], [3, 0, 0, 0]])
+    hyps, refs = bleu_output_transform(y_pred, y, i2w)
+    # row 0: hyp truncated at </s>; row 1: empty hyp → <???>; row 2: empty ref dropped
+    assert hyps == [["cat", "dog"], ["<???>"]]
+    assert refs == [["cat"], ["dog", "cat"]]
+
+
+def test_eval_accuracies_scale():
+    hyps = {0: ["the cat sat"], 1: ["a b c"]}
+    refs = {0: ["the cat sat"], 1: ["a b d"]}
+    bleu, rouge_l, meteor, ind_bleu, ind_rouge = eval_accuracies(hyps, refs)
+    assert 0 <= bleu <= 100 and 0 <= rouge_l <= 100 and 0 <= meteor <= 100
+    assert bleu > 50  # one perfect + one partial
+    assert len(ind_bleu) == len(ind_rouge) == 2
