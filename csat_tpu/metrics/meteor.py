@@ -25,24 +25,9 @@ import numpy as np
 __all__ = ["Meteor", "meteor_score"]
 
 
-def _align(hyp: Sequence[str], ref: Sequence[str]) -> Tuple[int, int]:
-    """Greedy left-to-right exact alignment → (#matches, #chunks)."""
-    used = [False] * len(ref)
-    align: List[int] = []  # ref index per matched hyp position, in hyp order
-    for h_tok in hyp:
-        best = -1
-        for j, r_tok in enumerate(ref):
-            if not used[j] and r_tok == h_tok:
-                best = j
-                break
-        if best >= 0:
-            used[best] = True
-            align.append(best)
-        else:
-            align.append(-1)
-    matches = sum(1 for a in align if a >= 0)
-    # chunks: maximal runs of adjacent hyp positions mapping to adjacent,
-    # increasing ref positions
+def _count_chunks(align: Sequence[int]) -> int:
+    """Chunks = maximal runs of matched hyp positions mapping to adjacent,
+    increasing ref positions."""
     chunks = 0
     prev = None
     for a in align:
@@ -52,7 +37,90 @@ def _align(hyp: Sequence[str], ref: Sequence[str]) -> Tuple[int, int]:
         if prev is None or a != prev + 1:
             chunks += 1
         prev = a
-    return matches, chunks
+    return chunks
+
+
+def _greedy_align(hyp: Sequence[str], ref: Sequence[str]) -> Tuple[int, int]:
+    """Adjacency-preferring greedy fallback (used when the exact search is
+    cut off): match each hyp token to the ref position following the previous
+    match when possible, else the first free occurrence."""
+    used = [False] * len(ref)
+    align: List[int] = []
+    prev = -2
+    for h_tok in hyp:
+        best = -1
+        if 0 <= prev + 1 < len(ref) and not used[prev + 1] and ref[prev + 1] == h_tok:
+            best = prev + 1
+        else:
+            for j, r_tok in enumerate(ref):
+                if not used[j] and r_tok == h_tok:
+                    best = j
+                    break
+        if best >= 0:
+            used[best] = True
+        align.append(best)
+        prev = best if best >= 0 else -2
+    return sum(1 for a in align if a >= 0), _count_chunks(align)
+
+
+def _align(hyp: Sequence[str], ref: Sequence[str], node_cap: int = 20000) -> Tuple[int, int]:
+    """METEOR exact-module alignment: among alignments with the maximal
+    number of matches, minimize the chunk count (Banerjee & Lavie 2005;
+    the reference's meteor-1.5.jar computes the same objective).
+
+    Branch-and-bound over hyp positions; exact for typical summary lengths,
+    falls back to an adjacency-preferring greedy if ``node_cap`` is hit.
+    """
+    from collections import Counter
+
+    h_cnt, r_cnt = Counter(hyp), Counter(ref)
+    quota = {t: min(c, r_cnt[t]) for t, c in h_cnt.items() if t in r_cnt}
+    matches = sum(quota.values())
+    if matches == 0:
+        return 0, 0
+    positions = {t: [j for j, r in enumerate(ref) if r == t] for t in quota}
+    # remaining hyp occurrences of each type after position i (for skip logic)
+    n = len(hyp)
+    remaining = [dict() for _ in range(n + 1)]
+    for i in range(n - 1, -1, -1):
+        remaining[i] = dict(remaining[i + 1])
+        remaining[i][hyp[i]] = remaining[i].get(hyp[i], 0) + 1
+
+    best = [float("inf")]
+    nodes = [0]
+    used = [False] * len(ref)
+
+    def dfs(i: int, need: dict, chunks: int, prev: int) -> None:
+        if chunks >= best[0] or nodes[0] > node_cap:
+            return
+        if i == n:
+            best[0] = chunks
+            return
+        nodes[0] += 1
+        tok = hyp[i]
+        left = need.get(tok, 0)
+        if left > 0:
+            # adjacent-first ordering finds low-chunk solutions early
+            cands = positions[tok]
+            ordered = sorted(
+                (j for j in cands if not used[j]),
+                key=lambda j: (j != prev + 1, j),
+            )
+            for j in ordered:
+                used[j] = True
+                need[tok] = left - 1
+                dfs(i + 1, need, chunks + (j != prev + 1), j)
+                need[tok] = left
+                used[j] = False
+        # skip this hyp position iff the quota can still be met later
+        if left == 0 or remaining[i + 1].get(tok, 0) >= left:
+            dfs(i + 1, need, chunks, -2)
+
+    dfs(0, dict(quota), 0, -2)
+    if nodes[0] > node_cap or best[0] == float("inf"):
+        g_m, g_c = _greedy_align(hyp, ref)
+        return (matches, min(g_c, best[0])) if best[0] != float("inf") else (g_m, g_c)
+    return matches, best[0]
 
 
 def meteor_score(hyp: Sequence[str], ref: Sequence[str]) -> float:

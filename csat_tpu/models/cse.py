@@ -30,7 +30,14 @@ import jax.numpy as jnp
 from flax import linen as nn
 
 from csat_tpu.configs import Config
-from csat_tpu.models.components import LN_EPS, XAVIER, FeedForward, dense, merge_heads
+from csat_tpu.models.components import (
+    LN_EPS,
+    XAVIER,
+    FeedForward,
+    dense,
+    masked_softmax,
+    merge_heads,
+)
 
 Dtype = Any
 
@@ -66,8 +73,8 @@ class DisentangledAttn(nn.Module):
         self,
         x: jnp.ndarray,  # (B, N, pegen_dim)
         rel_tables: jnp.ndarray,  # (2, R, pegen_dim) — stacked L_q, T_q
-        rel: jnp.ndarray,  # (B, 8, N, N) int32
-        mask: jnp.ndarray,  # (B, 8, N, N) bool
+        rel: jnp.ndarray,  # (B, 2, N, N) int32 — the distinct L/T planes
+        mask: jnp.ndarray,  # (B, 2, N, N) bool
         deterministic: bool = True,
     ) -> jnp.ndarray:
         cfg = self.cfg
@@ -101,11 +108,14 @@ class DisentangledAttn(nn.Module):
         if cfg.backend == "pallas":
             from csat_tpu.ops.cse_pallas import disentangled_attention_pallas
 
+            # rel/mask carry only the two distinct L/T planes; the kernel's
+            # index map fans each plane out to its 4 pseudo-heads.
             out = disentangled_attention_pallas(q, k, v, rel_q, rel_k, rel, mask)
         else:
-            scores = disentangled_scores(q, k, rel_q, rel_k, rel)
-            scores = jnp.where(mask, -1e9, scores)  # finite fill (ref :62)
-            attn = jax.nn.softmax(scores, axis=-1)
+            rel8 = jnp.repeat(rel, half, axis=1)
+            mask8 = jnp.repeat(mask, half, axis=1)
+            scores = disentangled_scores(q, k, rel_q, rel_k, rel8)
+            attn = masked_softmax(scores, mask8)
             out = jnp.einsum("bhnm,bhmd->bhnd", attn, v)
         out = merge_heads(out).astype(self.dtype)
         return dense(d, self.dtype, name="wo")(out)
@@ -147,15 +157,11 @@ class CSE(nn.Module):
         deterministic: bool = True,
     ) -> jnp.ndarray:
         cfg = self.cfg
-        half = cfg.num_heads // 2
-        rel = jnp.concatenate(
-            [jnp.repeat(L[:, None], half, axis=1), jnp.repeat(T[:, None], half, axis=1)],
-            axis=1,
-        ).astype(jnp.int32)
-        mask = jnp.concatenate(
-            [jnp.repeat(L_mask[:, None], half, axis=1), jnp.repeat(T_mask[:, None], half, axis=1)],
-            axis=1,
-        )
+        # Only the two distinct planes travel to the attention layers; the
+        # 4-L-heads + 4-T-heads tiling (ref csa_trans.py:204-211) happens at
+        # the point of use (XLA repeat / Pallas index map).
+        rel = jnp.stack([L, T], axis=1).astype(jnp.int32)  # (B, 2, N, N)
+        mask = jnp.stack([L_mask, T_mask], axis=1)
         l_q = self.param("L_q", XAVIER, (cfg.max_src_len, cfg.pegen_dim))
         t_q = self.param("T_q", XAVIER, (cfg.max_src_len, cfg.pegen_dim))
         rel_tables = jnp.stack([l_q, t_q]).astype(self.dtype)

@@ -107,7 +107,15 @@ class SBMAttention(nn.Module):
         if self.backend == "pallas":
             from csat_tpu.ops.sbm_pallas import sbm_attention_pallas
 
-            out, attn = sbm_attention_pallas(q, k, v, graph, key_pad)
+            if deterministic or self.attention_dropout == 0.0:
+                out, attn = sbm_attention_pallas(q, k, v, graph, key_pad)
+            else:
+                seed = jax.random.randint(
+                    self.make_rng("dropout"), (), 0, jnp.iinfo(jnp.int32).max, dtype=jnp.int32
+                )
+                out, attn = sbm_attention_pallas(
+                    q, k, v, graph, key_pad, self.attention_dropout, seed
+                )
         else:
             dot = jnp.einsum("bhnd,bhmd->bhnm", q, k) / math.sqrt(dh)
             dot = jnp.where(mask, -jnp.inf, dot)

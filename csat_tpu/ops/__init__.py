@@ -1,0 +1,23 @@
+"""Pallas TPU kernels for the two attention hot paths.
+
+The reference computes both attentions as chains of stock torch ops that
+materialize several (B, H, N, N) intermediates in device memory
+(``/root/reference/module/sbm_attn.py:32-66``,
+``module/disentangled_attn.py:44-65``). On TPU the bottleneck is HBM
+bandwidth, so these kernels fuse the whole score → mask → softmax →
+(graph ⊙ / relative-bias) → renormalize → ⊙V chain into a single VMEM-resident
+pass per (batch, head) tile, with hand-written backward kernels that
+recompute the cheap intermediates instead of storing them.
+
+Kernels:
+
+* :mod:`csat_tpu.ops.sbm_pallas` — SBM sampled-sparse attention
+  (masked softmax ⊙ sampled graph, L1 renorm, in-kernel dropout).
+* :mod:`csat_tpu.ops.cse_pallas` — disentangled relative attention for the
+  CSE positional-encoding stack.
+
+All kernels run in interpret mode off-TPU so the CPU test suite exercises
+them bit-for-bit.
+"""
+
+from csat_tpu.ops.sbm_pallas import sbm_attention_pallas  # noqa: F401

@@ -1,0 +1,241 @@
+"""Fused Pallas TPU kernel for SBM sampled-sparse attention.
+
+Replaces the XLA-op chain in :class:`csat_tpu.models.sbm.SBMAttention`
+(capability parity with ``/root/reference/module/sbm_attn.py:55-64``):
+
+    dot   = Q Kᵀ / √d, padded keys → -1e30
+    p     = softmax(dot)
+    w     = p ⊙ graph                    (graph: sampled 0/1 Bernoulli mask)
+    attn  = w / max(‖w‖₁, eps)           (torch F.normalize(p=1) semantics)
+    out   = dropout(attn) · V
+
+One grid program handles one (batch, head) pair; all (N, N) intermediates
+live in VMEM and are never written to HBM. The backward kernel recomputes
+the softmax/renorm chain from (q, k, graph) instead of storing residuals —
+at N≈150..512 recompute is far cheaper than the HBM round-trips it avoids.
+
+Dropout derives its keep-mask from a stateless counter-based hash
+(murmur3 finalizer over ``(seed, program, element index)``) computed in
+plain vector ops — forward and backward regenerate the identical mask
+without materializing a (B, H, N, N) tensor, and the same bits are
+produced on TPU and in interpret mode on CPU (the ``pltpu.prng_*``
+primitives return zeros under the CPU interpreter, so they are not used).
+
+Gradients flow to q, k, v AND the sampled graph — the straight-through
+estimator (``csat_tpu/models/ste.py``) consumes the graph cotangent.
+
+Off-TPU the kernels run in Pallas interpret mode, which keeps the CPU test
+suite exercising the exact kernel code path, including the in-kernel PRNG
+dropout (the interpreter implements ``pltpu.prng_*``).
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.dtypes import float0
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+L1_EPS = 1e-12
+NEG = -1e30
+
+
+def _interpret() -> bool:
+    return jax.default_backend() != "tpu"
+
+
+def _keep_mask(seed: jnp.ndarray, pid: jnp.ndarray, shape, rate: float) -> jnp.ndarray:
+    """Stateless counter-based keep-mask: murmur3 finalizer over
+    (seed, program id, element index). P(keep) = 1 - rate."""
+    n, m = shape
+    idx = jax.lax.broadcasted_iota(jnp.uint32, shape, 0) * jnp.uint32(m) + \
+        jax.lax.broadcasted_iota(jnp.uint32, shape, 1)
+    x = idx ^ (seed.astype(jnp.uint32) * jnp.uint32(0x9E3779B9))
+    x = x ^ (pid.astype(jnp.uint32) * jnp.uint32(0x85EBCA6B))
+    x = x ^ (x >> 16)
+    x = x * jnp.uint32(0x85EBCA6B)
+    x = x ^ (x >> 13)
+    x = x * jnp.uint32(0xC2B2AE35)
+    x = x ^ (x >> 16)
+    threshold = jnp.uint32(min(int(rate * float(2**32)), 2**32 - 1))
+    return (x >= threshold).astype(jnp.float32)
+
+
+def _attn_chain(q, k, graph, pad_row):
+    """Shared forward math: scores → softmax → ⊙graph → L1 renorm.
+
+    q, k: (N, dh) fp32; graph: (N, N); pad_row: (1, N), 1.0 where padded.
+    Returns (p, attn, z) with z = max(‖p⊙graph‖₁, eps) per row.
+    """
+    dh = q.shape[-1]
+    s = jnp.dot(q, k.T, preferred_element_type=jnp.float32) / math.sqrt(dh)
+    s = s + pad_row * NEG
+    m = jnp.max(s, axis=-1, keepdims=True)
+    e = jnp.exp(s - m)
+    p = e / jnp.sum(e, axis=-1, keepdims=True)
+    w = p * graph
+    z = jnp.maximum(jnp.sum(w, axis=-1, keepdims=True), L1_EPS)
+    return p, w / z, z
+
+
+def _fwd_kernel(seed_ref, q_ref, k_ref, v_ref, g_ref, pad_ref, out_ref, attn_ref, *, rate: float):
+    q = q_ref[0, 0]
+    k = k_ref[0, 0]
+    v = v_ref[0, 0]
+    _, attn, _ = _attn_chain(q, k, g_ref[0, 0], pad_ref[...])
+    attn_ref[0, 0] = attn
+    if rate > 0.0:
+        pid = pl.program_id(0) * pl.num_programs(1) + pl.program_id(1)
+        keep = _keep_mask(seed_ref[0], pid, attn.shape, rate)
+        attn_d = attn * keep * (1.0 / (1.0 - rate))
+    else:
+        attn_d = attn
+    out_ref[0, 0] = jnp.dot(attn_d, v, preferred_element_type=jnp.float32)
+
+
+def _bwd_kernel(
+    seed_ref, q_ref, k_ref, v_ref, g_ref, pad_ref, go_ref, ga_ref,
+    dq_ref, dk_ref, dv_ref, dg_ref, *, rate: float,
+):
+    q = q_ref[0, 0]
+    k = k_ref[0, 0]
+    v = v_ref[0, 0]
+    graph = g_ref[0, 0]
+    g_out = go_ref[0, 0]
+    p, attn, z = _attn_chain(q, k, graph, pad_ref[...])
+
+    if rate > 0.0:
+        pid = pl.program_id(0) * pl.num_programs(1) + pl.program_id(1)
+        keep = _keep_mask(seed_ref[0], pid, attn.shape, rate) * (1.0 / (1.0 - rate))
+        attn_d = attn * keep
+        d_attn = jnp.dot(g_out, v.T, preferred_element_type=jnp.float32) * keep + ga_ref[0, 0]
+    else:
+        attn_d = attn
+        d_attn = jnp.dot(g_out, v.T, preferred_element_type=jnp.float32) + ga_ref[0, 0]
+    dv_ref[0, 0] = jnp.dot(attn_d.T, g_out, preferred_element_type=jnp.float32)
+
+    # L1-renorm backward: attn = w / z, z = max(Σw, eps); when the row sum is
+    # below eps the denominator is constant so only the direct term survives.
+    w_sum = jnp.sum(p * graph, axis=-1, keepdims=True)
+    live = (w_sum >= L1_EPS).astype(jnp.float32)
+    d_w = (d_attn - live * jnp.sum(d_attn * attn, axis=-1, keepdims=True)) / z
+
+    dg_ref[0, 0] = d_w * p
+    d_p = d_w * graph
+    d_s = p * (d_p - jnp.sum(d_p * p, axis=-1, keepdims=True))
+    inv = 1.0 / math.sqrt(q.shape[-1])
+    dq_ref[0, 0] = jnp.dot(d_s, k, preferred_element_type=jnp.float32) * inv
+    dk_ref[0, 0] = jnp.dot(d_s.T, q, preferred_element_type=jnp.float32) * inv
+
+
+def _bh_spec(n: int, d: int):
+    return pl.BlockSpec((1, 1, n, d), lambda i, j: (i, j, 0, 0), memory_space=pltpu.VMEM)
+
+
+def _pad_spec(n: int):
+    return pl.BlockSpec((1, n), lambda i, j: (i, 0), memory_space=pltpu.VMEM)
+
+
+def _seed_spec():
+    return pl.BlockSpec(memory_space=pltpu.SMEM)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(6,))
+def _sbm_attn(q, k, v, graph, pad, seed_arr, rate):
+    out, attn = _fwd_call(q, k, v, graph, pad, seed_arr, rate)
+    return out, attn
+
+
+def _fwd_call(q, k, v, graph, pad, seed_arr, rate):
+    b, h, n, dh = q.shape
+    kernel = functools.partial(_fwd_kernel, rate=float(rate))
+    out, attn = pl.pallas_call(
+        kernel,
+        grid=(b, h),
+        in_specs=[
+            _seed_spec(),
+            _bh_spec(n, dh), _bh_spec(n, dh), _bh_spec(n, dh),
+            _bh_spec(n, n), _pad_spec(n),
+        ],
+        out_specs=[_bh_spec(n, dh), _bh_spec(n, n)],
+        out_shape=[
+            jax.ShapeDtypeStruct((b, h, n, dh), jnp.float32),
+            jax.ShapeDtypeStruct((b, h, n, n), jnp.float32),
+        ],
+        cost_estimate=pl.CostEstimate(
+            flops=b * h * (4 * n * n * dh + 8 * n * n),
+            bytes_accessed=b * h * (3 * n * dh + 2 * n * n) * 4,
+            transcendentals=b * h * n * n,
+        ),
+        interpret=_interpret(),
+    )(seed_arr, q, k, v, graph, pad)
+    return out, attn
+
+
+def _vjp_fwd(q, k, v, graph, pad, seed_arr, rate):
+    out, attn = _fwd_call(q, k, v, graph, pad, seed_arr, rate)
+    return (out, attn), (q, k, v, graph, pad, seed_arr)
+
+
+def _vjp_bwd(rate, res, cotangents):
+    q, k, v, graph, pad, seed_arr = res
+    g_out, g_attn = cotangents
+    b, h, n, dh = q.shape
+    kernel = functools.partial(_bwd_kernel, rate=float(rate))
+    dq, dk, dv, dg = pl.pallas_call(
+        kernel,
+        grid=(b, h),
+        in_specs=[
+            _seed_spec(),
+            _bh_spec(n, dh), _bh_spec(n, dh), _bh_spec(n, dh),
+            _bh_spec(n, n), _pad_spec(n),
+            _bh_spec(n, dh), _bh_spec(n, n),
+        ],
+        out_specs=[
+            _bh_spec(n, dh), _bh_spec(n, dh), _bh_spec(n, dh), _bh_spec(n, n),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((b, h, n, dh), jnp.float32),
+            jax.ShapeDtypeStruct((b, h, n, dh), jnp.float32),
+            jax.ShapeDtypeStruct((b, h, n, dh), jnp.float32),
+            jax.ShapeDtypeStruct((b, h, n, n), jnp.float32),
+        ],
+        cost_estimate=pl.CostEstimate(
+            flops=b * h * (10 * n * n * dh + 16 * n * n),
+            bytes_accessed=b * h * (6 * n * dh + 3 * n * n) * 4,
+            transcendentals=b * h * n * n,
+        ),
+        interpret=_interpret(),
+    )(seed_arr, q, k, v, graph, pad, g_out, g_attn)
+    d_pad = jnp.zeros_like(pad)
+    d_seed = np.zeros(seed_arr.shape, dtype=float0)
+    return dq, dk, dv, dg, d_pad, d_seed
+
+
+_sbm_attn.defvjp(_vjp_fwd, _vjp_bwd)
+
+
+def sbm_attention_pallas(
+    q: jnp.ndarray,        # (B, H, N, dh) fp32
+    k: jnp.ndarray,
+    v: jnp.ndarray,
+    graph: jnp.ndarray,    # (B, H, N, N) 0/1 fp32 (sampled via the STE)
+    key_pad: jnp.ndarray,  # (B, N), truthy = padded
+    dropout_rate: float = 0.0,
+    dropout_seed: Optional[jnp.ndarray] = None,
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Fused SBM attention. Returns ``(out, attn)``; ``attn`` is the
+    pre-dropout L1-renormalized map (the analysis tensor the reference
+    returns, ``sbm_attn.py:62-66``)."""
+    pad = key_pad.astype(jnp.float32)
+    if dropout_seed is None:
+        seed_arr = jnp.zeros((1,), dtype=jnp.int32)
+    else:
+        seed_arr = jnp.asarray(dropout_seed, dtype=jnp.int32).reshape((1,))
+    return _sbm_attn(q, k, v, graph, pad, seed_arr, float(dropout_rate))
