@@ -1,0 +1,214 @@
+"""L0 — AST extraction: source code → ``ast.original`` JSON lines.
+
+Capability parity with the reference's notebook-driven extraction layer
+(``/root/reference/py/process_utils.py``, ``java/process_utils.py``,
+``py/tree_sitter_parse.ipynb``): parse a function, build a DFS-ordered node
+graph where
+
+* non-terminals are ``"nont:<type>:<start>:<end>:<idx>"``;
+* identifier leaves are ``"idt:<token>:<start>:<end>:<idx>"``; snake_case /
+  camelCase identifiers are split into sub-token **chains**, each split
+  becoming a chained child of the previous one
+  (ref ``py/process_utils.py:222-229``);
+* punctuation, string and number literals are skipped
+  (ref ``py/process_utils.py:201,209-255``);
+
+and serialize one JSON node-list per line in exactly the schema the L1
+preprocessor consumes (``csat_tpu/data/ast_tools.py:ast_json_to_tree``,
+ref ``my_ast.py:103-126``): ``{"label": ..., "children": [child labels]}``
+with **1-indexed** trailing ids.
+
+Backends:
+
+* **stdlib ``ast``** (always available) — Python sources only. The node
+  *types* are CPython AST class names rather than tree-sitter grammar names;
+  the downstream pipeline only requires a consistent type vocabulary, which
+  this provides.
+* **tree-sitter** (optional, used when the ``tree_sitter`` package and a
+  language grammar are importable) — same node-graph construction driven by
+  the tree-sitter CST, for parity with the reference's exact node taxonomy
+  and for non-Python languages.
+"""
+
+from __future__ import annotations
+
+import ast as py_ast
+import json
+import re
+from typing import Iterable, List, Optional, Sequence, Tuple
+
+__all__ = [
+    "split_camelcase",
+    "split_identifier_into_parts",
+    "python_to_ast_json",
+    "extract_corpus",
+    "have_tree_sitter",
+]
+
+_CAMEL = re.compile(r"(?<=[a-z0-9])(?=[A-Z])|(?<=[A-Z])(?=[A-Z][a-z])")
+
+
+def split_camelcase(token: str) -> List[str]:
+    """``camelCaseHTTPWord`` → ``['camel', 'Case', 'HTTP', 'Word']``
+    (ref ``py/process_utils.py:split_camelcase``)."""
+    parts = _CAMEL.split(token)
+    return [p for p in parts if p]
+
+
+def split_identifier_into_parts(identifier: str) -> List[str]:
+    """snake_case first, then camelCase within each part
+    (ref ``py/process_utils.py:split_identifier_into_parts``)."""
+    out: List[str] = []
+    for snake in identifier.split("_"):
+        if not snake:
+            continue
+        out.extend(split_camelcase(snake))
+    return out or [identifier]
+
+
+class _GraphBuilder:
+    """Accumulates DFS-ordered nodes with reference label syntax."""
+
+    def __init__(self) -> None:
+        self.labels: List[str] = []
+        self.children: List[List[int]] = []
+
+    def add(self, kind: str, value: str, start: int, end: int) -> int:
+        value = value.replace(":", "") or "_"
+        idx = len(self.labels) + 1  # 1-indexed ids (ref my_ast.py:118-119)
+        self.labels.append(f"{kind}:{value}:{start}:{end}:{idx}")
+        self.children.append([])
+        return idx
+
+    def link(self, parent: int, child: int) -> None:
+        self.children[parent - 1].append(child)
+
+    def add_identifier_chain(self, parent: int, token: str, start: int, end: int) -> None:
+        """Sub-token chain: each split is a child of the previous split
+        (ref ``py/process_utils.py:222-229``)."""
+        prev = parent
+        for part in split_identifier_into_parts(token):
+            node = self.add("idt", part, start, end)
+            self.link(prev, node)
+            prev = node
+
+    def to_json(self) -> List[dict]:
+        out = []
+        for label, kids in zip(self.labels, self.children):
+            rec: dict = {"label": label}
+            if kids:
+                rec["children"] = [self.labels[k - 1] for k in kids]
+            out.append(rec)
+        return out
+
+
+def _py_walk(builder: _GraphBuilder, node: py_ast.AST, parent: Optional[int]) -> None:
+    kind = type(node).__name__
+    start = getattr(node, "lineno", 0) or 0
+    end = getattr(node, "end_lineno", start) or start
+    me = builder.add("nont", kind, start, end)
+    if parent is not None:
+        builder.link(parent, me)
+
+    # identifier-bearing fields become idt sub-token chains; string/number
+    # literals and pure punctuation are skipped (ref process_utils.py:201+)
+    for field in ("name", "id", "attr", "arg", "module"):
+        val = getattr(node, field, None)
+        if isinstance(val, str) and val:
+            builder.add_identifier_chain(me, val, start, end)
+    for child in py_ast.iter_child_nodes(node):
+        if isinstance(child, (py_ast.Load, py_ast.Store, py_ast.Del)):
+            continue  # expression-context markers carry no structure
+        _py_walk(builder, child, me)
+
+
+def python_to_ast_json(source: str) -> List[dict]:
+    """One Python function/module source → JSON node list (``ast.original``
+    line format)."""
+    tree = py_ast.parse(source)
+    # a single top-level def is the common corpus shape; descend into it so
+    # the root is the function, matching the reference's per-function trees
+    root: py_ast.AST = tree
+    if isinstance(tree, py_ast.Module) and len(tree.body) == 1:
+        root = tree.body[0]
+    builder = _GraphBuilder()
+    _py_walk(builder, root, None)
+    return builder.to_json()
+
+
+def have_tree_sitter(language: str = "python") -> bool:
+    try:  # pragma: no cover - environment dependent
+        import tree_sitter  # noqa: F401
+        __import__(f"tree_sitter_{language}")
+        return True
+    except Exception:
+        return False
+
+
+def _treesitter_to_ast_json(source: str, language: str) -> List[dict]:  # pragma: no cover
+    """tree-sitter CST → node graph, for environments with grammars installed."""
+    import tree_sitter
+
+    lang_mod = __import__(f"tree_sitter_{language}")
+    parser = tree_sitter.Parser(tree_sitter.Language(lang_mod.language()))
+    tree = parser.parse(source.encode())
+    builder = _GraphBuilder()
+
+    def walk(ts_node, parent):
+        if not ts_node.is_named:
+            return  # punctuation
+        kind = ts_node.type
+        start, end = ts_node.start_point[0] + 1, ts_node.end_point[0] + 1
+        if kind in ("string", "integer", "float", "number_literal", "string_literal"):
+            return  # literals skipped (ref process_utils.py:209-255)
+        if kind == "identifier" or kind.endswith("identifier"):
+            text = ts_node.text.decode(errors="replace")
+            builder.add_identifier_chain(parent, text, start, end)
+            return
+        me = builder.add("nont", kind, start, end)
+        if parent is not None:
+            builder.link(parent, me)
+        for child in ts_node.children:
+            walk(child, me)
+
+    walk(tree.root_node, None)
+    return builder.to_json()
+
+
+def source_to_ast_json(source: str, language: str = "python") -> List[dict]:
+    """Dispatch: tree-sitter when available, stdlib ``ast`` for Python."""
+    if have_tree_sitter(language):
+        return _treesitter_to_ast_json(source, language)
+    if language != "python":
+        raise RuntimeError(
+            f"extracting {language!r} requires the tree_sitter_{language} grammar; "
+            "only Python has a stdlib fallback"
+        )
+    return python_to_ast_json(source)
+
+
+def extract_corpus(
+    pairs: Iterable[Tuple[str, str]],
+    out_dir: str,
+    language: str = "python",
+) -> int:
+    """(source, natural-language summary) pairs → ``ast.original`` +
+    ``nl.original`` in ``out_dir`` (the L1 input contract,
+    ref ``process.py:42-63``). Unparseable sources are skipped. Returns the
+    number of examples written."""
+    import os
+
+    os.makedirs(out_dir, exist_ok=True)
+    n = 0
+    with open(os.path.join(out_dir, "ast.original"), "w") as fa, open(
+        os.path.join(out_dir, "nl.original"), "w"
+    ) as fn:
+        for source, nl in pairs:
+            try:
+                nodes = source_to_ast_json(source, language)
+            except SyntaxError:
+                continue
+            fa.write(json.dumps(nodes) + "\n")
+            fn.write(" ".join(nl.split()) + "\n")
+            n += 1
+    return n

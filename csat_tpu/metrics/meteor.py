@@ -123,9 +123,19 @@ def _align(hyp: Sequence[str], ref: Sequence[str], node_cap: int = 20000) -> Tup
     return matches, best[0]
 
 
-def meteor_score(hyp: Sequence[str], ref: Sequence[str]) -> float:
+def meteor_score(hyp: Sequence[str], ref: Sequence[str], use_native: bool = True) -> float:
     if not hyp or not ref:
         return 0.0
+    # the C ABI passes whitespace-joined strings, so it can only represent
+    # tokens that are non-empty and whitespace-free; fall back otherwise
+    if use_native and all(
+        t and not any(c.isspace() for c in t) for t in (*hyp, *ref)
+    ):
+        from csat_tpu.native import native_meteor_score
+
+        s = native_meteor_score(" ".join(hyp), " ".join(ref))
+        if s is not None:
+            return s
     m, chunks = _align(hyp, ref)
     if m == 0:
         return 0.0

@@ -1,0 +1,74 @@
+"""Native (C++) runtime components, loaded via ctypes.
+
+The reference's only native component is the METEOR jar it shells out to
+(``/root/reference/valid_metrices/meteor/meteor.py:192-213``). Here the
+equivalent scorer is a small C++ library compiled on demand with the
+toolchain baked into the image (no pybind11 required — plain C ABI +
+ctypes). ``csat_tpu.metrics.meteor`` transparently prefers it when it
+builds; the pure-Python scorer is the always-available fallback, and the
+two are held together by differential tests.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import tempfile
+from typing import Optional
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_LIB: Optional[ctypes.CDLL] = None
+_TRIED = False
+
+
+def _build(lib_path: str) -> bool:
+    src = os.path.join(_HERE, "meteor.cpp")
+    try:
+        subprocess.run(
+            ["g++", "-O2", "-shared", "-fPIC", "-o", lib_path, src],
+            check=True,
+            capture_output=True,
+            timeout=120,
+        )
+        return True
+    except Exception:
+        return False
+
+
+def load_meteor() -> Optional[ctypes.CDLL]:
+    """Compile (once) and load the native METEOR library; None if the
+    toolchain is unavailable."""
+    global _LIB, _TRIED
+    if _LIB is not None or _TRIED:
+        return _LIB
+    _TRIED = True
+    lib_path = os.path.join(_HERE, "libmeteor.so")
+    if not os.path.exists(lib_path) or os.path.getmtime(lib_path) < os.path.getmtime(
+        os.path.join(_HERE, "meteor.cpp")
+    ):
+        # build into a temp file first so concurrent workers never load a
+        # half-written library
+        fd, tmp = tempfile.mkstemp(suffix=".so", dir=_HERE)
+        os.close(fd)
+        if _build(tmp):
+            os.replace(tmp, lib_path)
+        else:
+            os.unlink(tmp)
+            return None
+    try:
+        lib = ctypes.CDLL(lib_path)
+        lib.meteor_score_c.argtypes = [ctypes.c_char_p, ctypes.c_char_p]
+        lib.meteor_score_c.restype = ctypes.c_double
+        _LIB = lib
+    except OSError:
+        return None
+    return _LIB
+
+
+def native_meteor_score(hyp: str, ref: str) -> Optional[float]:
+    """Score via the C++ library; None when it is unavailable."""
+    lib = load_meteor()
+    if lib is None:
+        return None
+    return float(lib.meteor_score_c(hyp.encode(), ref.encode()))

@@ -1,0 +1,151 @@
+// METEOR-lite scorer (exact-match module), native implementation.
+//
+// The reference runs METEOR as a JVM subprocess over a stdio line protocol
+// (/root/reference/valid_metrices/meteor/meteor.py:192-290, jar absent).
+// This library provides the same capability natively: unigram exact-match
+// alignment maximizing matches then minimizing chunk count (branch-and-bound,
+// greedy fallback past a node cap — semantics identical to
+// csat_tpu/metrics/meteor.py, which differential tests hold to this),
+// Fmean = 10PR/(R+9P), penalty 0.5*(chunks/m)^3.
+//
+// Exposed via a C ABI for ctypes:  double meteor_score_c(hyp, ref)
+// where hyp/ref are whitespace-tokenized UTF-8 strings.
+//
+// Build:  g++ -O2 -shared -fPIC -o libmeteor.so meteor.cpp
+
+#include <algorithm>
+#include <cstring>
+#include <limits>
+#include <map>
+#include <sstream>
+#include <string>
+#include <vector>
+
+namespace {
+
+std::vector<std::string> tokenize(const char* s) {
+    std::vector<std::string> out;
+    std::istringstream iss(s);
+    std::string tok;
+    while (iss >> tok) out.push_back(tok);
+    return out;
+}
+
+struct Aligner {
+    const std::vector<std::string>& hyp;
+    const std::vector<std::string>& ref;
+    std::map<std::string, int> quota;                    // per-type matches required
+    std::map<std::string, std::vector<int>> positions;   // ref positions per type
+    std::vector<std::map<std::string, int>> remaining;   // hyp occurrences at >= i
+    std::vector<char> used;
+    long node_cap, nodes = 0;
+    int best = std::numeric_limits<int>::max();
+
+    Aligner(const std::vector<std::string>& h, const std::vector<std::string>& r,
+            long cap)
+        : hyp(h), ref(r), node_cap(cap) {
+        std::map<std::string, int> h_cnt, r_cnt;
+        for (auto& t : hyp) h_cnt[t]++;
+        for (auto& t : ref) r_cnt[t]++;
+        for (auto& [t, c] : h_cnt)
+            if (r_cnt.count(t)) quota[t] = std::min(c, r_cnt[t]);
+        for (size_t j = 0; j < ref.size(); ++j)
+            if (quota.count(ref[j])) positions[ref[j]].push_back((int)j);
+        remaining.assign(hyp.size() + 1, {});
+        for (int i = (int)hyp.size() - 1; i >= 0; --i) {
+            remaining[i] = remaining[i + 1];
+            remaining[i][hyp[i]]++;
+        }
+        used.assign(ref.size(), 0);
+    }
+
+    int matches() const {
+        int m = 0;
+        for (auto& [t, q] : quota) m += q;
+        return m;
+    }
+
+    void dfs(size_t i, std::map<std::string, int>& need, int chunks, int prev) {
+        if (chunks >= best || nodes > node_cap) return;
+        if (i == hyp.size()) { best = chunks; return; }
+        ++nodes;
+        const std::string& tok = hyp[i];
+        auto it = need.find(tok);
+        int left = it == need.end() ? 0 : it->second;
+        if (left > 0) {
+            std::vector<int> cands;
+            for (int j : positions[tok]) if (!used[j]) cands.push_back(j);
+            // adjacent-first ordering finds low-chunk solutions early
+            std::stable_sort(cands.begin(), cands.end(), [&](int a, int b) {
+                return (a != prev + 1) < (b != prev + 1) || ((a != prev + 1) == (b != prev + 1) && a < b);
+            });
+            for (int j : cands) {
+                used[j] = 1;
+                it->second = left - 1;
+                dfs(i + 1, need, chunks + (j != prev + 1 ? 1 : 0), j);
+                it->second = left;
+                used[j] = 0;
+            }
+        }
+        auto rem = remaining[i + 1].find(tok);
+        int later = rem == remaining[i + 1].end() ? 0 : rem->second;
+        if (left == 0 || later >= left) dfs(i + 1, need, chunks, -2);
+    }
+
+    // adjacency-preferring greedy fallback (mirrors _greedy_align)
+    int greedy_chunks() {
+        std::fill(used.begin(), used.end(), 0);
+        int chunks = 0, prev = -2;
+        for (auto& tok : hyp) {
+            int bestj = -1;
+            if (prev + 1 >= 0 && prev + 1 < (int)ref.size() && !used[prev + 1] &&
+                ref[prev + 1] == tok)
+                bestj = prev + 1;
+            else
+                for (size_t j = 0; j < ref.size(); ++j)
+                    if (!used[j] && ref[j] == tok) { bestj = (int)j; break; }
+            if (bestj >= 0) {
+                used[bestj] = 1;
+                if (bestj != prev + 1) ++chunks;
+                prev = bestj;
+            } else
+                prev = -2;
+        }
+        return chunks;
+    }
+
+    // returns {matches, min chunks}
+    std::pair<int, int> run() {
+        int m = matches();
+        if (m == 0) return {0, 0};
+        std::map<std::string, int> need = quota;
+        dfs(0, need, 0, -2);
+        if (nodes > node_cap || best == std::numeric_limits<int>::max()) {
+            int g = greedy_chunks();
+            if (best != std::numeric_limits<int>::max()) g = std::min(g, best);
+            return {m, g};
+        }
+        return {m, best};
+    }
+};
+
+}  // namespace
+
+extern "C" {
+
+double meteor_score_c(const char* hyp_s, const char* ref_s) {
+    auto hyp = tokenize(hyp_s);
+    auto ref = tokenize(ref_s);
+    if (hyp.empty() || ref.empty()) return 0.0;
+    Aligner a(hyp, ref, 20000);
+    auto [m, chunks] = a.run();
+    if (m == 0) return 0.0;
+    double p = (double)m / hyp.size();
+    double r = (double)m / ref.size();
+    double fmean = 10.0 * p * r / (r + 9.0 * p);
+    double frac = (double)chunks / m;
+    double penalty = 0.5 * frac * frac * frac;
+    return fmean * (1.0 - penalty);
+}
+
+}  // extern "C"

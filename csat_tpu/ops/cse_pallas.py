@@ -41,24 +41,22 @@ def _interpret() -> bool:
 
 
 def _xla_forward(q, k, v, rel_q, rel_k, rel2, mask2_f32):
-    """Reference composition (mirrors ``models.cse.disentangled_scores``).
+    """XLA composition — single source of truth is the model path
+    (``models.cse.disentangled_scores`` + ``components.masked_softmax``);
+    the ``custom_vjp`` backward differentiates exactly what the model's XLA
+    branch computes.
 
     ``rel2``/``mask2``: the two distinct L/T planes (B, 2, N, N), fanned out
     to ``H`` heads here (first half L, second half T — SURVEY §8.4).
     """
+    from csat_tpu.models.components import masked_softmax
+    from csat_tpu.models.cse import disentangled_scores
+
     h = q.shape[1]
-    dk = q.shape[-1]
-    scale = math.sqrt(dk * 3)
     rel = jnp.repeat(rel2, h // 2, axis=1)
     mask_f32 = jnp.repeat(mask2_f32, h // 2, axis=1)
-    c2c = jnp.einsum("bhnd,bhmd->bhnm", q, k)
-    c2p = jnp.take_along_axis(jnp.einsum("bhnd,hrd->bhnr", q, rel_k), rel, axis=3)
-    p2c = jnp.swapaxes(
-        jnp.take_along_axis(jnp.einsum("bhnd,hrd->bhnr", k, rel_q), rel, axis=3), -1, -2
-    )
-    scores = (c2c + c2p + p2c) / scale
-    scores = jnp.where(mask_f32 > 0, NEG, scores)
-    attn = jax.nn.softmax(scores, axis=-1)
+    scores = disentangled_scores(q, k, rel_q, rel_k, rel)
+    attn = masked_softmax(scores, mask_f32 > 0, neg=NEG)
     return jnp.einsum("bhnm,bhmd->bhnd", attn, v)
 
 

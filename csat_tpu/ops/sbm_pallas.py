@@ -25,8 +25,8 @@ Gradients flow to q, k, v AND the sampled graph — the straight-through
 estimator (``csat_tpu/models/ste.py``) consumes the graph cotangent.
 
 Off-TPU the kernels run in Pallas interpret mode, which keeps the CPU test
-suite exercising the exact kernel code path, including the in-kernel PRNG
-dropout (the interpreter implements ``pltpu.prng_*``).
+suite exercising the exact kernel code path, including the hash-based
+dropout (which is why the hash is used instead of ``pltpu.prng_*``).
 """
 
 from __future__ import annotations

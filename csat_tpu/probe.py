@@ -1,0 +1,147 @@
+"""Intermediate-node-prediction probe for PE quality (RQ2).
+
+Capability parity with ``/root/reference/inp_py.py`` / ``inp_java.py``: for
+node pairs exactly ``hops`` apart in the AST (tree shortest path, found via
+networkx in the reference, ``inp_py.py:56-90``), extract the **post-expansion
+positional encoding** the encoder produced for each node (the third output
+of the model forward — ref ``module/sbm_model.py:54,70``, SURVEY §8.13),
+and train a small MLP to predict the *type* of the path's middle node from
+``concat(pe_a, pe_b)`` (ref ``inp_py.py:115-129,252-308``). Probe accuracy
+measures how much tree structure the PE encodes.
+
+Pure-JAX implementation: tree paths are computed from the dataset's
+``parent_idx`` arrays (no networkx), the MLP trains with optax under jit.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+
+def tree_path(parent_idx: Sequence[int], a: int, b: int) -> List[int]:
+    """Shortest path between nodes a and b in a rooted tree given parents."""
+    anc_a = {}
+    x, d = a, 0
+    while x >= 0:
+        anc_a[x] = d
+        x = int(parent_idx[x]) if x != 0 else -1
+        d += 1
+    x, path_b = b, []
+    while x not in anc_a:
+        path_b.append(x)
+        x = int(parent_idx[x])
+    lca = x
+    path_a, x = [], a
+    while x != lca:
+        path_a.append(x)
+        x = int(parent_idx[x])
+    return path_a + [lca] + path_b[::-1]
+
+
+def sample_pairs(
+    parent_idx: np.ndarray, n_nodes: int, hops: int, rng: np.random.Generator, cap: int = 32
+) -> List[Tuple[int, int, int]]:
+    """(a, b, middle) triples with path length ``hops`` (ref inp_py.py:56-90)."""
+    found = []
+    nodes = rng.permutation(n_nodes)
+    for a in nodes[: min(n_nodes, 24)]:
+        for b in nodes[: min(n_nodes, 24)]:
+            if b <= a:
+                continue
+            p = tree_path(parent_idx, int(a), int(b))
+            if len(p) == hops + 1:
+                found.append((int(a), int(b), p[hops // 2]))
+                if len(found) >= cap:
+                    return found
+    return found
+
+
+class _MLP:
+    """2-layer probe head (ref inp_py.py:115-129)."""
+
+    def __init__(self, in_dim: int, hidden: int, n_classes: int, key):
+        k1, k2 = jax.random.split(key)
+        s1 = (2.0 / in_dim) ** 0.5
+        s2 = (2.0 / hidden) ** 0.5
+        self.params = {
+            "w1": jax.random.normal(k1, (in_dim, hidden)) * s1,
+            "b1": jnp.zeros(hidden),
+            "w2": jax.random.normal(k2, (hidden, n_classes)) * s2,
+            "b2": jnp.zeros(n_classes),
+        }
+
+    @staticmethod
+    def apply(params, x):
+        h = jax.nn.relu(x @ params["w1"] + params["b1"])
+        return h @ params["w2"] + params["b2"]
+
+
+def run_probe(
+    pe: np.ndarray,          # (num_samples, N, pe_dim) extracted encodings
+    parent_idx: List[np.ndarray],
+    n_nodes: List[int],
+    node_types: List[np.ndarray],  # int type id per node, per sample
+    hops: int = 3,
+    epochs: int = 30,
+    seed: int = 0,
+) -> Dict[str, float]:
+    """Returns probe train/test accuracy for the given hop count."""
+    rng = np.random.default_rng(seed)
+    xs, ys = [], []
+    for i in range(len(n_nodes)):
+        for a, b, mid in sample_pairs(parent_idx[i], int(n_nodes[i]), hops, rng):
+            xs.append(np.concatenate([pe[i, a], pe[i, b]]))
+            ys.append(int(node_types[i][mid]))
+    if len(xs) < 8:
+        return {"hops": hops, "n_pairs": len(xs), "train_acc": 0.0, "test_acc": 0.0}
+    x = jnp.asarray(np.stack(xs), jnp.float32)
+    y = jnp.asarray(np.asarray(ys), jnp.int32)
+    n_classes = int(y.max()) + 1
+    n = x.shape[0]
+    split = max(1, int(0.8 * n))
+    perm = rng.permutation(n)
+    tr, te = perm[:split], perm[split:]
+
+    mlp = _MLP(x.shape[1], 256, n_classes, jax.random.key(seed))
+    opt = optax.adam(1e-3)
+    opt_state = opt.init(mlp.params)
+
+    @jax.jit
+    def step(params, opt_state, xb, yb):
+        def loss_fn(p):
+            logits = _MLP.apply(p, xb)
+            return optax.softmax_cross_entropy_with_integer_labels(logits, yb).mean()
+
+        loss, grads = jax.value_and_grad(loss_fn)(params)
+        updates, opt_state = opt.update(grads, opt_state)
+        return optax.apply_updates(params, updates), opt_state, loss
+
+    params = mlp.params
+    for _ in range(epochs):
+        params, opt_state, _ = step(params, opt_state, x[tr], y[tr])
+
+    def acc(idx):
+        if len(idx) == 0:
+            return 0.0
+        pred = jnp.argmax(_MLP.apply(params, x[idx]), -1)
+        return float(jnp.mean((pred == y[idx]).astype(jnp.float32)))
+
+    return {
+        "hops": hops,
+        "n_pairs": n,
+        "train_acc": round(acc(tr), 4),
+        "test_acc": round(acc(te), 4),
+    }
+
+
+def extract_pe(model, params, batch, key) -> np.ndarray:
+    """Post-expansion PE from the model forward (SURVEY §8.13)."""
+    _, _, pe, _, _ = model.apply({"params": params}, batch, rngs={"sample": key})
+    if pe is None:
+        raise ValueError("this PE variant produces no probe-visible encoding")
+    return np.asarray(pe)

@@ -1,0 +1,74 @@
+"""L0 extraction → L1 preprocessing round-trip."""
+
+import json
+import os
+
+import numpy as np
+
+from csat_tpu.data.ast_tools import ast_json_to_tree, build_matrices, preorder, truncate_preorder
+from csat_tpu.data.extract import (
+    extract_corpus,
+    python_to_ast_json,
+    split_camelcase,
+    split_identifier_into_parts,
+)
+
+SRC = '''
+def find_max_value(itemsList):
+    """docstring"""
+    best = None
+    for item in itemsList:
+        if best is None or item > best:
+            best = item
+    return best
+'''
+
+
+def test_identifier_splitting():
+    assert split_camelcase("camelCaseHTTPWord") == ["camel", "Case", "HTTP", "Word"]
+    assert split_identifier_into_parts("find_max_value") == ["find", "max", "value"]
+    assert split_identifier_into_parts("itemsList") == ["items", "List"]
+    assert split_identifier_into_parts("_") == ["_"]
+
+
+def test_python_extraction_schema():
+    nodes = python_to_ast_json(SRC)
+    # schema: label "kind:value:start:end:idx", 1-indexed trailing ids
+    for i, rec in enumerate(nodes):
+        parts = rec["label"].split(":")
+        assert parts[0] in ("nont", "idt")
+        assert int(parts[-1]) == i + 1
+    # root is the function def, and sub-token chain exists (find → max → value)
+    assert nodes[0]["label"].startswith("nont:FunctionDef")
+    labels = {r["label"].split(":")[1] for r in nodes}
+    assert {"find", "max", "value", "items", "List"} <= labels
+    chain = [r for r in nodes if r["label"].split(":")[1] == "max"][0]
+    assert any(c.split(":")[1] == "value" for c in chain.get("children", []))
+
+
+def test_extraction_feeds_preprocessing():
+    nodes = python_to_ast_json(SRC)
+    root = ast_json_to_tree(nodes)
+    seq = truncate_preorder(root, 20)
+    assert 0 < len(seq) <= 20
+    L, T = build_matrices(seq, 20)
+    # L/T antisymmetry invariants (SURVEY §4)
+    np.testing.assert_array_equal(L, -L.T)
+    np.testing.assert_array_equal(T, -T.T)
+    assert np.abs(L).sum() > 0  # tree has real ancestor structure
+
+
+def test_extract_corpus_files(tmp_path):
+    pairs = [
+        (SRC, "finds the maximum value"),
+        ("def broken(:", "never written"),  # skipped: SyntaxError
+        ("def add(a, b):\n    return a + b", "adds two numbers"),
+    ]
+    n = extract_corpus(pairs, str(tmp_path), "python")
+    assert n == 2
+    asts = open(os.path.join(tmp_path, "ast.original")).read().splitlines()
+    nls = open(os.path.join(tmp_path, "nl.original")).read().splitlines()
+    assert len(asts) == len(nls) == 2
+    for line in asts:
+        tree = ast_json_to_tree(json.loads(line))
+        assert len(preorder(tree)) > 3

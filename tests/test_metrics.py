@@ -92,3 +92,35 @@ def test_eval_accuracies_scale():
     assert 0 <= bleu <= 100 and 0 <= rouge_l <= 100 and 0 <= meteor <= 100
     assert bleu > 50  # one perfect + one partial
     assert len(ind_bleu) == len(ind_rouge) == 2
+
+
+def test_native_meteor_matches_python():
+    """C++ scorer (ctypes) must agree with the pure-Python scorer."""
+    import random
+
+    from csat_tpu.metrics.meteor import meteor_score
+    from csat_tpu.native import load_meteor
+
+    if load_meteor() is None:
+        import pytest
+
+        pytest.skip("native toolchain unavailable")
+    rng = random.Random(0)
+    vocab = ["the", "cat", "sat", "on", "mat", "a", "dog", "ran", "fast", "very"]
+    for _ in range(200):
+        hyp = [rng.choice(vocab) for _ in range(rng.randint(1, 12))]
+        ref = [rng.choice(vocab) for _ in range(rng.randint(1, 14))]
+        s_native = meteor_score(hyp, ref, use_native=True)
+        s_python = meteor_score(hyp, ref, use_native=False)
+        assert abs(s_native - s_python) < 1e-9, (hyp, ref, s_native, s_python)
+
+
+def test_meteor_min_chunk_alignment():
+    """The aligner must minimize chunks among maximal matchings: hyp 'a b'
+    vs ref 'b a b' has a 1-chunk alignment ('a b' contiguous at ref[1:3])."""
+    from csat_tpu.metrics.meteor import _align, meteor_score
+
+    m, chunks = _align(["a", "b"], ["b", "a", "b"])
+    assert (m, chunks) == (2, 1)
+    assert abs(meteor_score(["a", "b"], ["b", "a", "b"], use_native=False) - 
+               meteor_score(["a", "b"], ["b", "a", "b"], use_native=True)) < 1e-9

@@ -1,0 +1,49 @@
+"""PE probe (intermediate-node prediction, ref inp_py.py) on synthetic data."""
+
+import numpy as np
+
+from csat_tpu.probe import run_probe, sample_pairs, tree_path
+
+
+def _chain_parents(n):
+    # 0 ← 1 ← 2 ← ... a path graph
+    return np.array([0] + list(range(n - 1)), dtype=np.int64)
+
+
+def test_tree_path_chain():
+    p = _chain_parents(8)
+    assert tree_path(p, 2, 5) == [5, 4, 3, 2][::-1] or tree_path(p, 2, 5) == [2, 3, 4, 5]
+    assert len(tree_path(p, 0, 7)) == 8
+
+
+def test_tree_path_branching():
+    # 0 → (1, 2); 1 → 3; 2 → 4 : path 3..4 goes through the root
+    p = np.array([0, 0, 0, 1, 2], dtype=np.int64)
+    assert tree_path(p, 3, 4) == [3, 1, 0, 2, 4]
+
+
+def test_sample_pairs_hops():
+    p = _chain_parents(16)
+    rng = np.random.default_rng(0)
+    pairs = sample_pairs(p, 16, hops=3, rng=rng)
+    assert pairs
+    for a, b, mid in pairs:
+        path = tree_path(p, a, b)
+        assert len(path) == 4
+        assert mid in path
+
+
+def test_probe_learns_positional_signal():
+    """A PE that *is* the node position should let the probe recover the
+    middle node's type when types are position-determined."""
+    rng = np.random.default_rng(1)
+    n_samples, n_nodes, d = 24, 20, 8
+    pe = np.zeros((n_samples, n_nodes, d), np.float32)
+    for i in range(n_samples):
+        for j in range(n_nodes):
+            pe[i, j] = np.concatenate([[j, j % 5], rng.normal(size=d - 2) * 0.01])
+    parents = [_chain_parents(n_nodes) for _ in range(n_samples)]
+    types = [np.arange(n_nodes) % 5 for _ in range(n_samples)]
+    res = run_probe(pe, parents, [n_nodes] * n_samples, types, hops=3, epochs=150)
+    assert res["n_pairs"] > 50
+    assert res["train_acc"] > 0.8, res

@@ -1,0 +1,205 @@
+"""Measure the PyTorch reference-equivalent baseline for ``bench.py``.
+
+Independent PyTorch implementation of the reference's default training-step
+workload (``/root/reference/config/python.py``: pegen CSE + SBM sparse
+attention, 512/256 dims, batch 64, N=150) — written fresh from the
+architecture description in ``SURVEY.md`` §2/§3, not copied from the
+reference. It exists to put a measured number behind ``vs_baseline``:
+
+    python tools/bench_torch_baseline.py  →  baseline_torch.json
+
+The reference targets CUDA; this host exposes no CUDA device, so the
+measurement runs on whatever torch offers (recorded in the JSON). The
+north-star comparison (≥4× AST-nodes/sec/chip, ``BASELINE.json``) is
+defined against the reference on its own GPU hardware; this script gives
+the same-host number so ``bench.py`` can report a ratio that was actually
+measured rather than assumed.
+
+Workload per step (mirrors ``script/train.py:103-116``): forward,
+label-smoothed NLL + sparsity-weighted loss, backward, AdamW update.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import math
+import os
+import time
+
+import torch
+import torch.nn as nn
+import torch.nn.functional as F
+
+# ---- workload constants (ref config/python.py) ----
+SBM_DIM, PE_DIM, PEGEN_DIM, HIDDEN = 512, 256, 512, 512
+HEADS, CSE_LAYERS, SBM_LAYERS, DEC_LAYERS = 8, 4, 4, 4
+CLUSTERS, FFN, MAX_SRC, MAX_TGT = 10, 2048, 150, 50
+SRC_V, TGT_V, BATCH, SW = 10_000, 20_000, 64, 1e-2
+
+
+class DisentangledLayer(nn.Module):
+    """c2c + p2c + c2p relative attention (ref disentangled_attn.py:44-65)."""
+
+    def __init__(self):
+        super().__init__()
+        d, h = PEGEN_DIM, HEADS
+        self.h, self.dk = h, d // h
+        self.qkv = nn.Linear(d, 3 * d)
+        self.out = nn.Linear(d, d)
+        self.rel_q = nn.Linear(d, d // 2)
+        self.rel_k = nn.Linear(d, d // 2)
+        self.norm1 = nn.LayerNorm(d)
+        self.norm2 = nn.LayerNorm(d)
+        self.ffn = nn.Sequential(nn.Linear(d, d), nn.GELU(), nn.Linear(d, d))
+
+    def forward(self, x, tables, rel, mask):
+        b, n, d = x.shape
+        h, dk = self.h, self.dk
+        q, k, v = self.qkv(self.norm1(x)).chunk(3, -1)
+        q, k, v = (t.view(b, n, h, dk).transpose(1, 2) for t in (q, k, v))
+        # tables: (2, R, d) → per-pseudo-head-group projections
+        lq = self.rel_q(tables).view(2, -1, h // 2, dk).permute(0, 2, 1, 3)
+        lk = self.rel_k(tables).view(2, -1, h // 2, dk).permute(0, 2, 1, 3)
+        lq = lq.reshape(h, -1, dk)  # (H, R, dk): 4 L-heads then 4 T-heads
+        lk = lk.reshape(h, -1, dk)
+        scale = math.sqrt(3 * dk)
+        c2c = q @ k.transpose(-1, -2)
+        c2p = torch.gather(q @ lk.transpose(-1, -2), 3, rel)
+        p2c = torch.gather(k @ lq.transpose(-1, -2), 3, rel).transpose(-1, -2)
+        s = (c2c + c2p + p2c) / scale
+        s = s.masked_fill(mask, -1e9)
+        o = (F.softmax(s, -1) @ v).transpose(1, 2).reshape(b, n, d)
+        x = x + self.out(o)
+        return x + self.ffn(self.norm2(x))
+
+
+class SBMLayer(nn.Module):
+    """Cluster-sampled sparse attention block (ref sbm_attn.py:32-66)."""
+
+    def __init__(self):
+        super().__init__()
+        d, h = SBM_DIM, HEADS
+        self.h, self.dk = h, d // h
+        self.qkv = nn.Linear(d, 3 * d)
+        self.out = nn.Linear(d, d)
+        self.clusters = nn.Parameter(torch.empty(h, CLUSTERS, self.dk))
+        nn.init.orthogonal_(self.clusters.view(h * CLUSTERS, self.dk))
+        self.proj = nn.Sequential(
+            nn.Linear(self.dk, self.dk), nn.ReLU(),
+            nn.Linear(self.dk, self.dk), nn.ReLU(), nn.Linear(self.dk, self.dk)
+        )
+        self.norm1 = nn.LayerNorm(d)
+        self.norm2 = nn.LayerNorm(d)
+        self.ffn = nn.Sequential(nn.Linear(d, FFN), nn.GELU(), nn.Linear(FFN, d))
+
+    def forward(self, x, pad):
+        b, n, d = x.shape
+        h, dk = self.h, self.dk
+        q, k, v = self.qkv(self.norm1(x)).chunk(3, -1)
+        q, k, v = (t.view(b, n, h, dk).transpose(1, 2) for t in (q, k, v))
+        s = F.softmax(
+            (self.clusters @ self.clusters.transpose(-1, -2)).view(h, -1), -1
+        ).view(h, CLUSTERS, CLUSTERS)
+        q_hat = torch.sigmoid(
+            torch.einsum("bhnd,hkd->bhnk", self.proj(q), self.clusters))
+        k_hat = torch.sigmoid(
+            torch.einsum("bhnd,hkd->bhnk", self.proj(k), self.clusters))
+        exp_a = torch.einsum("bhnk,hkj,bhmj->bhnm", q_hat, s, k_hat)
+        a = torch.bernoulli(exp_a.clamp(0.01, 0.99))
+        graph = a + exp_a - exp_a.detach()  # straight-through surrogate
+        dot = (q @ k.transpose(-1, -2)) / math.sqrt(dk)
+        dot = dot.masked_fill(pad[:, None, None, :], -1e30)
+        attn = F.normalize(F.softmax(dot, -1) * graph, p=1, dim=-1)
+        sparsity = a.sum() / a.numel()
+        o = (attn @ v).transpose(1, 2).reshape(b, n, d)
+        x = x + self.out(o)
+        return x + self.ffn(self.norm2(x)), sparsity
+
+
+class Baseline(nn.Module):
+    def __init__(self):
+        super().__init__()
+        self.src_emb = nn.Embedding(SRC_V, SBM_DIM - PE_DIM)
+        self.pe_emb = nn.Embedding(SRC_V, PEGEN_DIM)
+        self.tables = nn.Parameter(torch.randn(2, MAX_SRC, PEGEN_DIM) * 0.02)
+        self.cse = nn.ModuleList(DisentangledLayer() for _ in range(CSE_LAYERS))
+        self.pe_expand = nn.Linear(PEGEN_DIM, PE_DIM)
+        self.sbm = nn.ModuleList(SBMLayer() for _ in range(SBM_LAYERS))
+        self.enc_out = nn.Linear(SBM_DIM, HIDDEN)
+        self.tgt_emb = nn.Embedding(TGT_V, HIDDEN)
+        dec_layer = nn.TransformerDecoderLayer(
+            HIDDEN, HEADS, FFN, dropout=0.2, activation="gelu", batch_first=True
+        )
+        self.dec = nn.TransformerDecoder(dec_layer, DEC_LAYERS)
+        self.gen = nn.Linear(HIDDEN, TGT_V)
+
+    def forward(self, src, tgt, rel, rel_mask, pad):
+        pe = self.pe_emb(src)
+        for layer in self.cse:
+            pe = layer(pe, self.tables, rel, rel_mask)
+        x = torch.cat([self.src_emb(src), self.pe_expand(pe)], -1)
+        sparsities = []
+        for layer in self.sbm:
+            x, sp = layer(x, pad)
+            sparsities.append(sp)
+        mem = self.enc_out(x)
+        n = tgt.shape[1]
+        causal = torch.triu(torch.ones(n, n, dtype=torch.bool), 1)
+        out = self.dec(self.tgt_emb(tgt), mem, tgt_mask=causal)
+        return F.log_softmax(self.gen(out), -1), torch.stack(sparsities).mean()
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--steps", type=int, default=5)
+    ap.add_argument("--batch", type=int, default=BATCH)
+    args = ap.parse_args()
+
+    dev = "cuda" if torch.cuda.is_available() else "cpu"
+    torch.manual_seed(0)
+    model = Baseline().to(dev)
+    opt = torch.optim.AdamW(model.parameters(), lr=1e-4, eps=1e-6)
+
+    b = args.batch
+    src = torch.randint(4, SRC_V, (b, MAX_SRC), device=dev)
+    tgt = torch.randint(4, TGT_V, (b, MAX_TGT), device=dev)
+    rel = torch.randint(0, MAX_SRC, (b, HEADS, MAX_SRC, MAX_SRC), device=dev)
+    rel_mask = rel == 75  # distance-0 pairs masked (SURVEY §8.3)
+    pad = torch.zeros(b, MAX_SRC, dtype=torch.bool, device=dev)
+
+    def step():
+        opt.zero_grad()
+        logp, sparsity = model(src, tgt[:, :-1], rel, rel_mask, pad)
+        loss = F.nll_loss(logp.reshape(-1, TGT_V), tgt[:, 1:].reshape(-1))
+        (loss + SW * sparsity).backward()
+        opt.step()
+        return loss
+
+    step()  # warmup
+    if dev == "cuda":
+        torch.cuda.synchronize()
+    t0 = time.perf_counter()
+    for _ in range(args.steps):
+        loss = step()
+    if dev == "cuda":
+        torch.cuda.synchronize()
+    dt = time.perf_counter() - t0
+    nodes_per_sec = b * MAX_SRC * args.steps / dt
+
+    result = {
+        "ast_nodes_per_sec_per_chip": round(nodes_per_sec, 1),
+        "device": dev,
+        "torch": torch.__version__,
+        "steps": args.steps,
+        "batch": b,
+        "loss": float(loss),
+    }
+    path = os.path.join(os.path.dirname(os.path.abspath(__file__)), "..", "baseline_torch.json")
+    with open(os.path.abspath(path), "w") as f:
+        json.dump(result, f, indent=1)
+    print(json.dumps(result))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,121 @@
+"""Forward / forward+backward wall-time and peak-memory harness.
+
+Capability parity with ``/root/reference/csa_trans_time_memory.py:88-158``,
+which defines the repo's perf protocol: 20 repetitions of (a) forward-only
+and (b) forward+backward sweeps over a fixed batch stream, reporting wall
+seconds and peak device memory.
+
+TPU translation: ``torch.cuda.Event`` timing → ``block_until_ready`` around
+jitted calls; ``memory_stats()["allocated_bytes.all.peak"]`` →
+``device.memory_stats()["peak_bytes_in_use"]`` (0 when the backend does not
+expose it, e.g. CPU).
+
+    python tools/time_memory.py [--config python] [--backend pallas]
+                                [--batch 64] [--reps 20] [--steps 8]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+
+
+def peak_bytes() -> int:
+    try:
+        stats = jax.devices()[0].memory_stats() or {}
+        return int(stats.get("peak_bytes_in_use", 0))
+    except Exception:
+        return 0
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--config", default="python")
+    ap.add_argument("--backend", default="")
+    ap.add_argument("--compute_dtype", default="")
+    ap.add_argument("--batch", type=int, default=64)
+    ap.add_argument("--reps", type=int, default=20)
+    ap.add_argument("--steps", type=int, default=8, help="batches per rep")
+    args = ap.parse_args()
+
+    from csat_tpu.configs import get_config
+    from csat_tpu.data.toy import random_batch
+    from csat_tpu.train.loop import make_train_step
+    from csat_tpu.train.state import create_train_state, default_optimizer, make_model
+
+    overrides = {"batch_size": args.batch}
+    if args.backend:
+        overrides["backend"] = args.backend
+    if args.compute_dtype:
+        overrides["compute_dtype"] = args.compute_dtype
+    cfg = get_config(args.config, **overrides)
+    src_v, tgt_v, trip_v = 10_000, 20_000, 1246
+    batches = [
+        jax.tree.map(jax.device_put, random_batch(cfg, cfg.batch_size, src_v, tgt_v, trip_v, seed=s))
+        for s in range(args.steps)
+    ]
+    model = make_model(cfg, src_v, tgt_v, trip_v)
+    tx = default_optimizer(cfg)
+    state = create_train_state(model, tx, batches[0], seed=cfg.seed)
+    step = make_train_step(model, tx, cfg)
+
+    @jax.jit
+    def fwd(params, batch, key):
+        log_probs, sparsity, _, _, _ = model.apply(
+            {"params": params}, batch, rngs={"sample": key}
+        )
+        return log_probs, sparsity
+
+    key = jax.random.key(0)
+
+    # --- forward-only sweep (ref :103-125) ---
+    jax.block_until_ready(fwd(state.params, batches[0], key))  # compile
+    fwd_times = []
+    for _ in range(args.reps):
+        t0 = time.perf_counter()
+        for b in batches:
+            out = fwd(state.params, b, key)
+        jax.block_until_ready(out)
+        fwd_times.append(time.perf_counter() - t0)
+    fwd_peak = peak_bytes()
+
+    # --- forward+backward sweep (ref :129-149) ---
+    state, m = step(state, batches[0])  # compile
+    jax.block_until_ready(m["loss"])
+    fb_times = []
+    for _ in range(args.reps):
+        t0 = time.perf_counter()
+        for b in batches:
+            state, m = step(state, b)
+        jax.block_until_ready(m["loss"])
+        fb_times.append(time.perf_counter() - t0)
+    fb_peak = peak_bytes()
+
+    nodes = cfg.batch_size * cfg.max_src_len * args.steps
+    result = {
+        "config": cfg.name,
+        "backend": cfg.backend,
+        "compute_dtype": cfg.compute_dtype,
+        "device": str(jax.devices()[0]),
+        "fwd_sec_mean": round(sum(fwd_times) / len(fwd_times), 4),
+        "fwd_sec_min": round(min(fwd_times), 4),
+        "fwd_peak_gb": round(fwd_peak / 2**30, 3),
+        "fwdbwd_sec_mean": round(sum(fb_times) / len(fb_times), 4),
+        "fwdbwd_sec_min": round(min(fb_times), 4),
+        "fwdbwd_peak_gb": round(fb_peak / 2**30, 3),
+        "fwd_nodes_per_sec": round(nodes / min(fwd_times), 1),
+        "fwdbwd_nodes_per_sec": round(nodes / min(fb_times), 1),
+    }
+    print(json.dumps(result))
+
+
+if __name__ == "__main__":
+    main()
