@@ -171,6 +171,16 @@ _reg(_JAVA.replace(name="java_treepos", use_pegen="treepos"))
 _reg(_JAVA.replace(name="java_triplet", use_pegen="triplet"))
 _reg(_JAVA.replace(name="java_compare_codescribe", data_dir="./processed/compare_codescribe_java"))
 
+# Long-AST stress configs (north star: max_ast_len=512, 4→64 chips DP,
+# /root/repo/BASELINE.json:11) — beyond the reference's hard 150-node cap.
+# The node axis can additionally be sharded over a `seq` mesh axis
+# (sequence/context parallelism); override mesh_shape to enable, e.g.
+# mesh_shape=(("data", -1), ("seq", 2)).
+_reg(_JAVA.replace(name="java_long", task_name="long_ast_512", max_src_len=512,
+                   mesh_shape=(("data", -1),)))
+_reg(_PY.replace(name="python_long", task_name="long_ast_512", max_src_len=512,
+                 mesh_shape=(("data", -1),)))
+
 
 def get_config(name: str, **overrides) -> Config:
     """Look up a named variant; keyword overrides are applied on top."""

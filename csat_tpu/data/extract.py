@@ -206,7 +206,9 @@ def extract_corpus(
         for source, nl in pairs:
             try:
                 nodes = source_to_ast_json(source, language)
-            except SyntaxError:
+            except (SyntaxError, ValueError, RecursionError):
+                # ValueError: NUL bytes in source; RecursionError: absurdly
+                # nested code — all count as unparseable and are skipped
                 continue
             fa.write(json.dumps(nodes) + "\n")
             fn.write(" ".join(nl.split()) + "\n")

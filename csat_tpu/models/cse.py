@@ -166,9 +166,12 @@ class CSE(nn.Module):
         t_q = self.param("T_q", XAVIER, (cfg.max_src_len, cfg.pegen_dim))
         rel_tables = jnp.stack([l_q, t_q]).astype(self.dtype)
 
-        x = src_pe_emb
+        from csat_tpu.parallel.mesh import constrain
+
+        x = constrain(src_pe_emb, "data", "seq", None)
         for i in range(cfg.num_layers):
             x = CSELayer(cfg, self.dtype, name=f"layer_{i}")(
                 x, rel_tables, rel, mask, deterministic
             )
+            x = constrain(x, "data", "seq", None)
         return nn.LayerNorm(epsilon=LN_EPS, dtype=self.dtype)(x)

@@ -217,12 +217,18 @@ class SBMEncoder(nn.Module):
             pe = dense(cfg.pe_dim, self.dtype, name="pe_expand")(src_pe)
             x = jnp.concatenate([src_emb, pe], axis=-1)
 
+        # sequence-parallel long-AST sharding: node axis on the mesh's `seq`
+        # axis (no-op outside a seq mesh) — see csat_tpu/parallel/mesh.py
+        from csat_tpu.parallel.mesh import constrain
+
+        x = constrain(x, "data", "seq", None)
         sparsities: List[jnp.ndarray] = []
         graphs, attns = [], []
         for i in range(cfg.sbm_layers):
             x, sparsity, graph, attn = SBMBlock(cfg, i, self.dtype, name=f"transformer_{i}")(
                 x, key_pad, deterministic
             )
+            x = constrain(x, "data", "seq", None)
             sparsities.append(sparsity)
             if collect_aux:
                 graphs.append(graph)

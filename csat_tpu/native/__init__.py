@@ -43,25 +43,27 @@ def load_meteor() -> Optional[ctypes.CDLL]:
     if _LIB is not None or _TRIED:
         return _LIB
     _TRIED = True
-    lib_path = os.path.join(_HERE, "libmeteor.so")
-    if not os.path.exists(lib_path) or os.path.getmtime(lib_path) < os.path.getmtime(
-        os.path.join(_HERE, "meteor.cpp")
-    ):
-        # build into a temp file first so concurrent workers never load a
-        # half-written library
-        fd, tmp = tempfile.mkstemp(suffix=".so", dir=_HERE)
-        os.close(fd)
-        if _build(tmp):
-            os.replace(tmp, lib_path)
-        else:
-            os.unlink(tmp)
-            return None
     try:
+        lib_path = os.path.join(_HERE, "libmeteor.so")
+        if not os.path.exists(lib_path) or os.path.getmtime(lib_path) < os.path.getmtime(
+            os.path.join(_HERE, "meteor.cpp")
+        ):
+            # build into a temp file first so concurrent workers never load a
+            # half-written library
+            fd, tmp = tempfile.mkstemp(suffix=".so", dir=_HERE)
+            os.close(fd)
+            if _build(tmp):
+                os.replace(tmp, lib_path)
+            else:
+                os.unlink(tmp)
+                return None
         lib = ctypes.CDLL(lib_path)
         lib.meteor_score_c.argtypes = [ctypes.c_char_p, ctypes.c_char_p]
         lib.meteor_score_c.restype = ctypes.c_double
         _LIB = lib
     except OSError:
+        # read-only install dir, missing sources, unloadable library — the
+        # pure-Python scorer is the always-available fallback
         return None
     return _LIB
 

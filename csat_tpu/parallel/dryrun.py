@@ -17,7 +17,7 @@ import numpy as np
 
 from csat_tpu.configs import Config, get_config
 from csat_tpu.data.toy import random_batch
-from csat_tpu.parallel.mesh import batch_sharding, build_mesh, param_sharding, replicated
+from csat_tpu.parallel.mesh import build_mesh, param_sharding, replicated, shard_batch
 from csat_tpu.train.loop import make_train_step
 from csat_tpu.train.optimizer import AdamWState
 from csat_tpu.train.state import TrainState, create_train_state, default_optimizer, make_model
@@ -25,7 +25,12 @@ from csat_tpu.train.state import TrainState, create_train_state, default_optimiz
 __all__ = ["dryrun_train_step", "tiny_multichip_config"]
 
 
-def tiny_multichip_config(n_devices: int, data: int, model_par: int) -> Config:
+def tiny_multichip_config(
+    n_devices: int, data: int, model_par: int, seq_par: int = 1
+) -> Config:
+    mesh = [("data", data), ("model", model_par)]
+    if seq_par > 1:
+        mesh.append(("seq", seq_par))
     return get_config(
         "python",
         pe_dim=32,
@@ -37,31 +42,34 @@ def tiny_multichip_config(n_devices: int, data: int, model_par: int) -> Config:
         sbm_layers=2,
         clusters=(4, 4),
         dim_feed_forward=256,
-        max_src_len=32,
+        max_src_len=32 * max(seq_par, 1),  # longer trees when seq-sharded
         max_tgt_len=12,
         batch_size=2 * data,
         tree_pos_width=4,
         tree_pos_height=8,
-        mesh_shape=(("data", data), ("model", model_par)),
+        mesh_shape=tuple(mesh),
     )
 
 
-def dryrun_train_step(n_devices: int, model_par: int = 2, cfg: Config = None) -> Tuple[float, dict]:
+def dryrun_train_step(
+    n_devices: int, model_par: int = 2, seq_par: int = 1, cfg: Config = None
+) -> Tuple[float, dict]:
     """Build mesh, shard state + batch, run one jitted train step.
 
-    Returns (loss, info) — info records mesh shape and a sample param
-    sharding for inspection.
+    Covers dp (``data``), tp (``model``), and sp (``seq`` node-axis)
+    shardings. Returns (loss, info) — info records mesh shape and a sample
+    param sharding for inspection.
     """
     devices = jax.devices()
     assert len(devices) >= n_devices, (
         f"need {n_devices} devices, have {len(devices)} — run under "
         f"XLA_FLAGS=--xla_force_host_platform_device_count={n_devices} JAX_PLATFORMS=cpu"
     )
-    if n_devices % model_par:
-        model_par = 1
-    data = n_devices // model_par
+    if n_devices % (model_par * max(seq_par, 1)):
+        model_par, seq_par = 1, 1
+    data = n_devices // (model_par * max(seq_par, 1))
     if cfg is None:
-        cfg = tiny_multichip_config(n_devices, data, model_par)
+        cfg = tiny_multichip_config(n_devices, data, model_par, seq_par)
     mesh = build_mesh(cfg.mesh_shape, devices[:n_devices])
 
     src_v, tgt_v, trip_v = 97, 83, 31
@@ -70,7 +78,8 @@ def dryrun_train_step(n_devices: int, model_par: int = 2, cfg: Config = None) ->
     tx = default_optimizer(cfg)
     state = create_train_state(model, tx, batch, seed=0)
 
-    # shard: params/opt-moments by TP rules, scalars replicated, batch on data
+    # shard: params/opt-moments by TP rules, scalars replicated, batch on
+    # data (src-node axes additionally on seq)
     p_sh = param_sharding(state.params, mesh)
     state_sh = TrainState(
         step=replicated(mesh),
@@ -79,11 +88,12 @@ def dryrun_train_step(n_devices: int, model_par: int = 2, cfg: Config = None) ->
         rng=replicated(mesh),
     )
     state = jax.device_put(state, state_sh)
-    batch = jax.device_put(batch, batch_sharding(mesh))
+    batch = shard_batch(batch, mesh)
 
     step = make_train_step(model, tx, cfg)
-    new_state, metrics = step(state, batch)
-    loss = float(metrics["loss"])
+    with jax.sharding.set_mesh(mesh):  # activates the model's seq constraints
+        new_state, metrics = step(state, batch)
+        loss = float(metrics["loss"])
     assert np.isfinite(loss), "non-finite loss in multichip dry-run"
     # a TP-sharded kernel should actually be sharded over `model`
     sample = new_state.params["decoder"]["layer_0"]["self_attn"]["q"]["kernel"]

@@ -1,0 +1,72 @@
+"""Multi-host bring-up: the DCN-scale analogue of the reference's
+``idist.Parallel(backend="nccl")`` driver (``/root/reference/script/train.py:331``).
+
+On TPU pods there is no NCCL and no process group to babysit:
+``jax.distributed.initialize`` wires the hosts together once, every host
+runs the same jitted train step over a global mesh, and XLA routes
+collectives over ICI within a slice and DCN across slices. The only
+host-side responsibilities are (a) per-host data sharding — each host feeds
+its local devices its slice of the batch stream
+(``iterate_batches(num_shards=jax.process_count(), ...)``) — and (b)
+rank-0-only side effects (checkpoints, logs), mirroring the reference's
+rank-0 gating (``train.py:196,210,247``).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional, Sequence, Tuple
+
+import jax
+
+from csat_tpu.parallel.mesh import build_mesh
+
+__all__ = ["initialize_multihost", "global_mesh", "is_primary"]
+
+
+def initialize_multihost(
+    coordinator_address: Optional[str] = None,
+    num_processes: Optional[int] = None,
+    process_id: Optional[int] = None,
+) -> None:
+    """Join the multi-host job. Must run before any other JAX backend use.
+
+    No-op when the process group is already up or when nothing identifies a
+    multi-host job (no explicit arguments and no coordinator in the
+    environment) — the common local single-process case. When a coordinator
+    IS configured, failures propagate: silently falling back to single-host
+    would train N independent un-synced models."""
+    if jax.distributed.is_initialized():
+        return
+    explicit = any(
+        v is not None for v in (coordinator_address, num_processes, process_id)
+    )
+    auto = any(
+        os.environ.get(k)
+        for k in (
+            "COORDINATOR_ADDRESS",
+            "JAX_COORDINATOR_ADDRESS",
+            "MEGASCALE_COORDINATOR_ADDRESS",
+        )
+    )
+    if not (explicit or auto):
+        return
+    jax.distributed.initialize(
+        coordinator_address=coordinator_address,
+        num_processes=num_processes,
+        process_id=process_id,
+    )
+
+
+def global_mesh(
+    mesh_shape: Sequence[Tuple[str, int]] = (("data", -1),),
+) -> jax.sharding.Mesh:
+    """Mesh over ALL devices across hosts. With the conventional axis order
+    (data outermost) XLA keeps gradient psums on ICI inside each slice and
+    only crosses DCN for the inter-slice partial reductions."""
+    return build_mesh(mesh_shape, jax.devices())
+
+
+def is_primary() -> bool:
+    """Rank-0 gate for checkpoints/logging (ref ``train.py:196``)."""
+    return jax.process_index() == 0

@@ -12,6 +12,12 @@ the XLA way: a named :class:`jax.sharding.Mesh` over all devices with
   QKV/output projections are sharded on the head dimension and the FFN on
   its hidden dimension, following the Megatron column/row pattern. XLA
   inserts the matching all-reduces.
+* ``seq`` axis — sequence (context) parallelism over the AST-node axis for
+  long-AST configs (``max_ast_len=512`` stress, SURVEY §5): node-axis
+  batch fields and encoder activations are sharded ``P('data', 'seq', …)``
+  via :func:`constrain`; XLA turns the attention contractions into
+  all-gather-K/V + locally-blocked score computation over ICI. The
+  reference has no long-sequence story at all (hard 150-node cap).
 
 Multi-host: ``jax.distributed.initialize`` + per-host data sharding
 (``iterate_batches(num_shards=jax.process_count(), ...)``) extend the same
@@ -35,6 +41,8 @@ from csat_tpu.data.dataset import Batch
 __all__ = [
     "build_mesh",
     "batch_sharding",
+    "batch_shardings",
+    "constrain",
     "param_sharding",
     "replicated",
     "shard_batch",
@@ -110,6 +118,39 @@ def batch_sharding(mesh: Mesh) -> NamedSharding:
     return NamedSharding(mesh, P("data"))
 
 
+def batch_shardings(mesh: Mesh) -> Batch:
+    """Field-aware shardings: batch dim on ``data``; the AST-node axis of
+    src-side fields additionally on ``seq`` when the mesh carries one.
+    Target-side fields never shard their token axis (decoding is causal)."""
+    s = "seq" if mesh.shape.get("seq", 1) > 1 else None
+    d = "data"
+    return Batch(
+        src_seq=NamedSharding(mesh, P(d, s)),
+        tgt_seq=NamedSharding(mesh, P(d, None)),
+        target=NamedSharding(mesh, P(d, None)),
+        L=NamedSharding(mesh, P(d, s, None)),
+        T=NamedSharding(mesh, P(d, s, None)),
+        L_mask=NamedSharding(mesh, P(d, s, None)),
+        T_mask=NamedSharding(mesh, P(d, s, None)),
+        num_node=NamedSharding(mesh, P(d)),
+        adj=NamedSharding(mesh, P(d, s, None)),
+        tree_pos=NamedSharding(mesh, P(d, s, None)),
+        triplet=NamedSharding(mesh, P(d, s)),
+    )
+
+
 def shard_batch(batch: Batch, mesh: Mesh) -> Batch:
-    sh = batch_sharding(mesh)
-    return jax.tree.map(lambda x: jax.device_put(x, sh), batch)
+    shs = batch_shardings(mesh)
+    return jax.tree.map(jax.device_put, batch, shs)
+
+
+def constrain(x: jax.Array, *axes) -> jax.Array:
+    """``with_sharding_constraint`` against the ambient mesh set via
+    ``jax.sharding.set_mesh``; axis names absent from that mesh degrade to
+    ``None`` and outside any mesh this is the identity — so model code can
+    annotate unconditionally."""
+    mesh = jax.sharding.get_abstract_mesh()
+    if mesh is None or not mesh.axis_names:
+        return x
+    spec = P(*[a if a in mesh.axis_names else None for a in axes])
+    return jax.lax.with_sharding_constraint(x, spec)

@@ -172,6 +172,19 @@ class Trainer:
         num_epochs: Optional[int] = None,
         checkpoint_fn: Optional[Callable[[TrainState, int], None]] = None,
     ) -> Tuple[TrainState, Dict[str, Any]]:
+        # the ambient mesh activates the model's `seq`/`data` sharding
+        # constraints (csat_tpu/parallel/mesh.py:constrain) inside the
+        # jitted step — without it sequence parallelism would be inert
+        with jax.sharding.set_mesh(self.mesh):
+            return self._fit(train_ds, val_ds, num_epochs, checkpoint_fn)
+
+    def _fit(
+        self,
+        train_ds: ASTDataset,
+        val_ds: Optional[ASTDataset] = None,
+        num_epochs: Optional[int] = None,
+        checkpoint_fn: Optional[Callable[[TrainState, int], None]] = None,
+    ) -> Tuple[TrainState, Dict[str, Any]]:
         cfg = self.cfg
         num_epochs = num_epochs or cfg.num_epochs
         example = next(iterate_batches(train_ds, cfg.batch_size, shuffle=False))

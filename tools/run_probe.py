@@ -1,0 +1,87 @@
+"""Run the PE probe (intermediate-node prediction) on a trained model.
+
+Parity entry point for the reference's ``inp_py.py`` / ``inp_java.py``
+experiments: for each hop count (3/5/7, ref ``inp_py.py:56-90``) sample
+node pairs that many edges apart in the test-set ASTs, take the
+post-expansion PE the encoder produced for the pair, and fit an MLP to
+predict the middle node's token id.
+
+    python tools/run_probe.py --config python --data_dir ./data \
+        [--checkpoint_dir outputs/...] [--hops 3 5 7]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import numpy as np
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--config", default="python")
+    ap.add_argument("--data_dir", default=None)
+    ap.add_argument("--split", default="test")
+    ap.add_argument("--checkpoint_dir", default=None)
+    ap.add_argument("--hops", type=int, nargs="+", default=[3, 5, 7])
+    ap.add_argument("--max_samples", type=int, default=256)
+    args = ap.parse_args()
+
+    from csat_tpu.configs import get_config
+    from csat_tpu.data.dataset import ASTDataset, iterate_batches, load_matrices
+    from csat_tpu.data.vocab import load_vocab
+    from csat_tpu.probe import extract_pe, run_probe
+    from csat_tpu.train.checkpoint import restore_params
+    from csat_tpu.train.state import create_train_state, default_optimizer, make_model
+
+    overrides = {}
+    if args.data_dir:
+        overrides["data_dir"] = args.data_dir
+    cfg = get_config(args.config, **overrides)
+    src_vocab, tgt_vocab = load_vocab(cfg.data_dir)
+    ds = ASTDataset(cfg, args.split, src_vocab, tgt_vocab)
+    mats = load_matrices(os.path.join(cfg.data_dir, args.split, "split_matrices.npz"))
+    records = mats["root_first_seq"]
+
+    model = make_model(cfg, src_vocab.size(), tgt_vocab.size(), 2048)
+    first = next(iterate_batches(ds, cfg.batch_size, shuffle=False, drop_last=False))
+    state = create_train_state(model, default_optimizer(cfg), first, seed=0)
+    params = state.params
+    if args.checkpoint_dir:
+        params = restore_params(args.checkpoint_dir)
+
+    pes, parents, n_nodes, types = [], [], [], []
+    key = jax.random.key(0)
+    seen = 0
+    for batch in iterate_batches(ds, cfg.batch_size, shuffle=False, drop_last=False):
+        key, sub = jax.random.split(key)
+        pe = extract_pe(model, params, batch, sub)  # (B, N, pe_dim)
+        for b in range(pe.shape[0]):
+            if seen >= min(args.max_samples, len(records)):
+                break
+            rec = records[seen]
+            n = min(int(batch.num_node[b]), len(rec.parent_idx))
+            pes.append(pe[b])
+            parents.append(np.maximum(rec.parent_idx[:n], 0))
+            n_nodes.append(n)
+            types.append(np.asarray(batch.src_seq[b]))
+            seen += 1
+        if seen >= min(args.max_samples, len(records)):
+            break
+
+    pes_arr = np.stack(pes)
+    results = [
+        run_probe(pes_arr, parents, n_nodes, types, hops=h, epochs=100)
+        for h in args.hops
+    ]
+    print(json.dumps({"config": cfg.name, "split": args.split, "probe": results}))
+
+
+if __name__ == "__main__":
+    main()
