@@ -84,6 +84,9 @@ class Config:
     compute_dtype: str = "float32"  # "bfloat16" for MXU-friendly training
     mesh_shape: Tuple[Tuple[str, int], ...] = (("data", 1), ("model", 1))
     decode_with_cache: bool = True
+    # rematerialize encoder blocks in backward (jax.checkpoint): trades
+    # FLOPs for the (B, H, N, N) activation memory — for long-AST configs
+    remat: bool = False
     # reference-compat quirk flags (SURVEY.md §8) — default reproduces
     generator_dropout: bool = True  # dropout-before-softmax Generator quirk
 

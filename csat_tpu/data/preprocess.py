@@ -49,11 +49,31 @@ def _process_one(args: Tuple[str, int]):
     return rec, levels, L, T
 
 
-def process_split(split_dir: str, max_ast_len: int, n_jobs: int = 0) -> int:
-    """Process one split directory containing ``ast.original`` (+ ``nl.original``)."""
+def process_split(
+    split_dir: str, max_ast_len: int, n_jobs: int = 0, ignore_idx: Tuple[int, ...] = ()
+) -> int:
+    """Process one split directory containing ``ast.original`` (+ ``nl.original``).
+
+    ``ignore_idx``: 0-based sample indices to drop from BOTH the AST stream
+    and ``nl.original`` — the reference's ast-trans comparison mode
+    (``process.py:15-28,34-40``, ``skip_code_and_nl_with_skip_id``), which
+    filters samples the comparison pipeline cannot process so corpora stay
+    aligned across frameworks.
+    """
     ast_path = os.path.join(split_dir, "ast.original")
-    with open(ast_path, "r", encoding="utf-8") as f:
+    with open(ast_path, "r", encoding="utf-8", errors="replace") as f:
         lines = [ln for ln in f.read().splitlines() if ln.strip()]
+    if ignore_idx:
+        skip = set(ignore_idx)
+        lines = [ln for i, ln in enumerate(lines) if i not in skip]
+        nl_path = os.path.join(split_dir, "nl.original")
+        if os.path.exists(nl_path):
+            with open(nl_path, "r", encoding="utf-8", errors="replace") as f:
+                nls = f.read().splitlines()
+            kept = [nl for i, nl in enumerate(nls) if i not in skip]
+            with open(nl_path + ".filtered", "w", encoding="utf-8") as f:
+                f.write("\n".join(kept) + "\n")
+            shutil.move(nl_path + ".filtered", nl_path)
 
     work = [(ln, max_ast_len) for ln in lines]
     if n_jobs and n_jobs > 1:
@@ -80,12 +100,21 @@ def process_split(split_dir: str, max_ast_len: int, n_jobs: int = 0) -> int:
     return len(records)
 
 
-def process_dataset(data_dir: str, max_ast_len: int, make_vocab: bool = True, n_jobs: int = 0) -> None:
+def process_dataset(
+    data_dir: str,
+    max_ast_len: int,
+    make_vocab: bool = True,
+    n_jobs: int = 0,
+    ignore_idx: dict = None,
+) -> None:
+    """``ignore_idx``: optional {split: (indices…)} for the ast-trans
+    comparison mode (see :func:`process_split`)."""
     for split in SPLITS:
         split_dir = os.path.join(data_dir, split)
         if not os.path.exists(os.path.join(split_dir, "ast.original")):
             continue
-        n = process_split(split_dir, max_ast_len, n_jobs=n_jobs)
+        skip = tuple((ignore_idx or {}).get(split, ()))
+        n = process_split(split_dir, max_ast_len, n_jobs=n_jobs, ignore_idx=skip)
         print(f"{split}: processed {n} ASTs (max {max_ast_len} nodes)")
     if make_vocab:
         src_v, tgt_v, trip_v = create_vocab(data_dir)
@@ -101,9 +130,18 @@ def main() -> None:
     p.add_argument("--process", action="store_true")
     p.add_argument("--make_vocab", action="store_true")
     p.add_argument("--n_jobs", type=int, default=os.cpu_count() or 1)
+    p.add_argument(
+        "--ignore_idx",
+        default=None,
+        help='JSON {split: [indices]} to drop (ast-trans comparison mode, ref process.py:34-40)',
+    )
     args = p.parse_args()
+    ignore = json.loads(args.ignore_idx) if args.ignore_idx else None
     if args.process:
-        process_dataset(args.data_dir, args.max_ast_len, make_vocab=False, n_jobs=args.n_jobs)
+        process_dataset(
+            args.data_dir, args.max_ast_len, make_vocab=False, n_jobs=args.n_jobs,
+            ignore_idx=ignore,
+        )
     if args.make_vocab:
         src_v, tgt_v, trip_v = create_vocab(args.data_dir)
         print(f"vocabs: ast={src_v.size()} nl={tgt_v.size()} triplet={trip_v.size()}")

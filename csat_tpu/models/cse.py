@@ -169,8 +169,9 @@ class CSE(nn.Module):
         from csat_tpu.parallel.mesh import constrain
 
         x = constrain(src_pe_emb, "data", "seq", None)
+        layer_cls = nn.remat(CSELayer, static_argnums=(5,)) if cfg.remat else CSELayer
         for i in range(cfg.num_layers):
-            x = CSELayer(cfg, self.dtype, name=f"layer_{i}")(
+            x = layer_cls(cfg, self.dtype, name=f"layer_{i}")(
                 x, rel_tables, rel, mask, deterministic
             )
             x = constrain(x, "data", "seq", None)

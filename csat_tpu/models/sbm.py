@@ -224,8 +224,13 @@ class SBMEncoder(nn.Module):
         x = constrain(x, "data", "seq", None)
         sparsities: List[jnp.ndarray] = []
         graphs, attns = [], []
+        # remat: recompute block activations in backward instead of storing
+        # them (jax.checkpoint) — the long-AST memory lever (SURVEY §7.1)
+        block_cls = (
+            nn.remat(SBMBlock, static_argnums=(3,)) if cfg.remat else SBMBlock
+        )
         for i in range(cfg.sbm_layers):
-            x, sparsity, graph, attn = SBMBlock(cfg, i, self.dtype, name=f"transformer_{i}")(
+            x, sparsity, graph, attn = block_cls(cfg, i, self.dtype, name=f"transformer_{i}")(
                 x, key_pad, deterministic
             )
             x = constrain(x, "data", "seq", None)

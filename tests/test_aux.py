@@ -1,0 +1,63 @@
+"""Aux-component tests: match accuracy, remat equivalence, preprocess skip."""
+
+import json
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from csat_tpu.metrics.acc import MatchAccMetric, match_accuracy
+from csat_tpu.utils import PAD
+
+
+def test_match_accuracy_counts():
+    y = np.array([[5, 6, PAD], [7, PAD, PAD]])
+    y_pred = np.array([[5, 9, PAD], [7, 1, 2]])
+    m, t = match_accuracy(y_pred, y)
+    assert (m, t) == (2, 3)
+    metric = MatchAccMetric()
+    metric.update(y_pred, y)
+    metric.update(y_pred, y)
+    assert abs(metric.compute() - 2 / 3) < 1e-9
+
+
+def test_preprocess_ignore_idx(tmp_path):
+    from csat_tpu.data.extract import extract_corpus
+    from csat_tpu.data.preprocess import process_split
+
+    pairs = [(f"def f{i}(x):\n    return x + {i}", f"adds {i}") for i in range(5)]
+    d = str(tmp_path / "train")
+    extract_corpus(pairs, d, "python")
+    n = process_split(d, max_ast_len=32, ignore_idx=(1, 3))
+    assert n == 3
+    nls = open(os.path.join(d, "nl.original")).read().split("\n")
+    assert nls[:3] == ["adds 0", "adds 2", "adds 4"]
+
+
+def test_remat_forward_and_grads_match(tiny_config):
+    from csat_tpu.data.toy import random_batch
+    from csat_tpu.train.state import make_model
+
+    outs = {}
+    for remat in (False, True):
+        cfg = tiny_config.replace(remat=remat, dropout=0.0, attention_dropout=0.0)
+        batch = random_batch(cfg, 2, 50, 60, 30, seed=0)
+        model = make_model(cfg, 50, 60, 30)
+        variables = model.init(
+            {"params": jax.random.key(0), "sample": jax.random.key(1)}, batch
+        )
+
+        def loss_fn(params):
+            log_probs, sparsity, _, _, _ = model.apply(
+                {"params": params}, batch, rngs={"sample": jax.random.key(7)}
+            )
+            return jnp.sum(log_probs) + jnp.sum(jnp.asarray(sparsity))
+
+        loss, grads = jax.value_and_grad(loss_fn)(variables["params"])
+        outs[remat] = (float(loss), grads)
+    assert abs(outs[True][0] - outs[False][0]) < 1e-3
+    flat_t = jax.tree.leaves(outs[True][1])
+    flat_f = jax.tree.leaves(outs[False][1])
+    for a, b in zip(flat_t, flat_f):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=2e-4)
