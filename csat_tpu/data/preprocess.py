@@ -54,26 +54,31 @@ def process_split(
 ) -> int:
     """Process one split directory containing ``ast.original`` (+ ``nl.original``).
 
-    ``ignore_idx``: 0-based sample indices to drop from BOTH the AST stream
-    and ``nl.original`` — the reference's ast-trans comparison mode
-    (``process.py:15-28,34-40``, ``skip_code_and_nl_with_skip_id``), which
-    filters samples the comparison pipeline cannot process so corpora stay
-    aligned across frameworks.
+    ``ignore_idx``: 0-based RAW line indices (shared by ``ast.original`` and
+    ``nl.original``) to drop from both streams — the reference's ast-trans
+    comparison mode (``process.py:15-28,34-40``,
+    ``skip_code_and_nl_with_skip_id``), which filters samples the comparison
+    pipeline cannot process so corpora stay aligned across frameworks.
+    Idempotent: the first filtering run snapshots the pristine files to
+    ``*.raw`` and every subsequent run re-filters from the snapshot.
     """
     ast_path = os.path.join(split_dir, "ast.original")
-    with open(ast_path, "r", encoding="utf-8", errors="replace") as f:
-        lines = [ln for ln in f.read().splitlines() if ln.strip()]
+    nl_path = os.path.join(split_dir, "nl.original")
     if ignore_idx:
         skip = set(ignore_idx)
-        lines = [ln for i, ln in enumerate(lines) if i not in skip]
-        nl_path = os.path.join(split_dir, "nl.original")
-        if os.path.exists(nl_path):
-            with open(nl_path, "r", encoding="utf-8", errors="replace") as f:
-                nls = f.read().splitlines()
-            kept = [nl for i, nl in enumerate(nls) if i not in skip]
-            with open(nl_path + ".filtered", "w", encoding="utf-8") as f:
+        # filter from pristine snapshots so re-running never double-drops
+        for path in (ast_path, nl_path):
+            if not os.path.exists(path) and not os.path.exists(path + ".raw"):
+                continue
+            if not os.path.exists(path + ".raw"):
+                shutil.copy(path, path + ".raw")
+            with open(path + ".raw", "r", encoding="utf-8", errors="replace") as f:
+                raw = f.read().splitlines()
+            kept = [ln for i, ln in enumerate(raw) if i not in skip]
+            with open(path, "w", encoding="utf-8") as f:
                 f.write("\n".join(kept) + "\n")
-            shutil.move(nl_path + ".filtered", nl_path)
+    with open(ast_path, "r", encoding="utf-8", errors="replace") as f:
+        lines = [ln for ln in f.read().splitlines() if ln.strip()]
 
     work = [(ln, max_ast_len) for ln in lines]
     if n_jobs and n_jobs > 1:

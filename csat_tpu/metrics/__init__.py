@@ -2,3 +2,4 @@ from csat_tpu.metrics.bleu import compute_bleu, corpus_bleu, sentence_bleu  # no
 from csat_tpu.metrics.meteor import Meteor, meteor_score  # noqa: F401
 from csat_tpu.metrics.rouge import Rouge  # noqa: F401
 from csat_tpu.metrics.scores import batch_bleu, bleu_output_transform, eval_accuracies  # noqa: F401
+from csat_tpu.metrics.acc import MatchAccMetric, match_accuracy  # noqa: F401

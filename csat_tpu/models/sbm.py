@@ -84,6 +84,7 @@ class SBMAttention(nn.Module):
         v: jnp.ndarray,
         key_pad: jnp.ndarray,  # (B, N) bool/float, truthy = padded
         deterministic: bool = True,
+        need_aux: bool = False,
     ) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray, jnp.ndarray]:
         b, h, n, dh = q.shape
         kk = self.num_clusters
@@ -98,24 +99,43 @@ class SBMAttention(nn.Module):
         proj = ClusterProj(dh)
         q_hat = jax.nn.sigmoid(jnp.einsum("bhnd,hkd->bhnk", proj(q, deterministic), clusters))
         k_hat = jax.nn.sigmoid(jnp.einsum("bhnd,hkd->bhnk", proj(k, deterministic), clusters))
+        noise = bernoulli_noise(self.make_rng("sample"), (b, h, n, n))
+
+        use_dropout = (not deterministic) and self.attention_dropout > 0.0
+        if self.backend == "pallas" and not need_aux:
+            # fully-fused path: expA, the sampled graph, the scores and the
+            # attention map never reach HBM (csat_tpu/ops/sbm_fused_pallas.py)
+            from csat_tpu.ops.sbm_fused_pallas import sbm_attention_fused_pallas
+
+            seed = (
+                jax.random.randint(
+                    self.make_rng("dropout"), (), 0, jnp.iinfo(jnp.int32).max, dtype=jnp.int32
+                )
+                if use_dropout
+                else None
+            )
+            out, graph_sums, _ = sbm_attention_fused_pallas(
+                q, k, v, q_hat, k_hat, s, noise, key_pad,
+                self.attention_dropout if use_dropout else 0.0, seed,
+            )
+            sparsity = jnp.sum(graph_sums, axis=0) / (b * n * n)  # (H,)
+            return out, sparsity, None, None
+
         exp_a = jnp.einsum("bhnk,hkj,bhmj->bhnm", q_hat, s, k_hat)
-
-        noise = bernoulli_noise(self.make_rng("sample"), exp_a.shape)
         graph = sample_graph(exp_a, noise)
-
         mask = key_pad[:, None, None, :].astype(bool)
         if self.backend == "pallas":
             from csat_tpu.ops.sbm_pallas import sbm_attention_pallas
 
-            if deterministic or self.attention_dropout == 0.0:
-                out, attn = sbm_attention_pallas(q, k, v, graph, key_pad)
-            else:
+            if use_dropout:
                 seed = jax.random.randint(
                     self.make_rng("dropout"), (), 0, jnp.iinfo(jnp.int32).max, dtype=jnp.int32
                 )
                 out, attn = sbm_attention_pallas(
                     q, k, v, graph, key_pad, self.attention_dropout, seed
                 )
+            else:
+                out, attn = sbm_attention_pallas(q, k, v, graph, key_pad)
         else:
             dot = jnp.einsum("bhnd,bhmd->bhnm", q, k) / math.sqrt(dh)
             dot = jnp.where(mask, -jnp.inf, dot)
@@ -152,7 +172,7 @@ class SBMBlock(nn.Module):
     dtype: Dtype = jnp.float32
 
     @nn.compact
-    def __call__(self, x, key_pad, deterministic: bool = True):
+    def __call__(self, x, key_pad, deterministic: bool = True, need_aux: bool = False):
         cfg = self.cfg
         d = cfg.sbm_enc_dim
         h = nn.LayerNorm(epsilon=LN_EPS, dtype=self.dtype)(x)
@@ -172,7 +192,7 @@ class SBMBlock(nn.Module):
                 cfg.clusters[self.layer_idx],
                 cfg.attention_dropout,
                 backend=cfg.backend,
-            )(q, k, v, key_pad, deterministic)
+            )(q, k, v, key_pad, deterministic, need_aux)
         attn_out = dense(d, self.dtype, name="wo")(merge_heads(attn_out).astype(self.dtype))
         x = x + nn.Dropout(cfg.dropout)(attn_out, deterministic=deterministic)
 
@@ -227,11 +247,11 @@ class SBMEncoder(nn.Module):
         # remat: recompute block activations in backward instead of storing
         # them (jax.checkpoint) — the long-AST memory lever (SURVEY §7.1)
         block_cls = (
-            nn.remat(SBMBlock, static_argnums=(3,)) if cfg.remat else SBMBlock
+            nn.remat(SBMBlock, static_argnums=(3, 4)) if cfg.remat else SBMBlock
         )
         for i in range(cfg.sbm_layers):
             x, sparsity, graph, attn = block_cls(cfg, i, self.dtype, name=f"transformer_{i}")(
-                x, key_pad, deterministic
+                x, key_pad, deterministic, collect_aux
             )
             x = constrain(x, "data", "seq", None)
             sparsities.append(sparsity)

@@ -1,0 +1,265 @@
+"""Fully-fused SBM attention: cluster adjacency + STE sampling + attention.
+
+Extends :mod:`csat_tpu.ops.sbm_pallas` by moving the *whole* SBM chain of
+``/root/reference/module/sbm_attn.py:38-64`` + ``STE.py`` into one kernel:
+
+    expA  = Q̂ S K̂ᵀ                       (cluster expected adjacency)
+    A     = 1{noise < clamp(expA, .01, .99)}   (Bernoulli sample, STE)
+    p     = softmax(QKᵀ/√d + pad·(-1e30))
+    attn  = (p ⊙ A) / max(‖p ⊙ A‖₁, eps)
+    out   = dropout(attn) · V
+    spars = Σ A                           (per (batch, head), for the loss)
+
+so the (B, H, N, N) tensors ``expA``, ``A``, the raw scores and the
+attention map never exist in HBM — only the small membership factors
+(Q̂, K̂: (B, H, N, K)), the affinity S (H, K, K) and the uniform noise enter.
+The noise stays an *input* (not in-kernel PRNG) so the sampled graph is
+bit-identical to the XLA path given the same ``jax.random`` stream — the
+model-level backend-equivalence tests rely on this.
+
+Backward recomputes the chain and implements the straight-through
+estimator exactly as ``csat_tpu/models/ste.py``: the cotangent reaching the
+sampled graph (attention path + sparsity-regularizer path) is gated as
+``clip(A · g, -1, 1)`` and pushed through the adjacency factorization to
+Q̂, K̂ and S (S's per-program partials are summed over the batch outside).
+
+``return_attn=False`` (training) skips the (B, H, N, N) attention write
+entirely; ``True`` returns it for the analysis/aux path.
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.dtypes import float0
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from csat_tpu.ops.sbm_pallas import L1_EPS, _attn_chain, _interpret, _keep_mask
+
+
+def _chain(q, k, q_hat, k_hat, s, noise, pad_row):
+    """Graph sampling + the shared scores/softmax/renorm chain
+    (:func:`csat_tpu.ops.sbm_pallas._attn_chain` — single source of truth).
+    Returns (graph, p, attn, z)."""
+    exp_a = jnp.dot(
+        jnp.dot(q_hat, s, preferred_element_type=jnp.float32),
+        k_hat.T,
+        preferred_element_type=jnp.float32,
+    )
+    graph = (noise < jnp.clip(exp_a, 0.01, 0.99)).astype(jnp.float32)
+    p, attn, z = _attn_chain(q, k, graph, pad_row)
+    return graph, p, attn, z
+
+
+def _fwd_kernel(
+    seed_ref, q_ref, k_ref, v_ref, qh_ref, kh_ref, s_ref, noise_ref, pad_ref,
+    out_ref, spars_ref, attn_ref, *, rate: float, return_attn: bool,
+):
+    q, k, v = q_ref[0, 0], k_ref[0, 0], v_ref[0, 0]
+    graph, _, attn, _ = _chain(
+        q, k, qh_ref[0, 0], kh_ref[0, 0], s_ref[0], noise_ref[0, 0], pad_ref[...]
+    )
+    spars_ref[0, 0] = jnp.sum(graph)
+    if return_attn:
+        attn_ref[0, 0] = attn
+    else:
+        attn_ref[0, 0] = jnp.zeros(attn_ref.shape[2:], jnp.float32)
+    if rate > 0.0:
+        pid = pl.program_id(0) * pl.num_programs(1) + pl.program_id(1)
+        attn = attn * _keep_mask(seed_ref[0], pid, attn.shape, rate) * (1.0 / (1.0 - rate))
+    out_ref[0, 0] = jnp.dot(attn, v, preferred_element_type=jnp.float32)
+
+
+def _bwd_kernel(
+    seed_ref, q_ref, k_ref, v_ref, qh_ref, kh_ref, s_ref, noise_ref, pad_ref,
+    go_ref, gs_ref, *rest, rate: float, has_ga: bool,
+):
+    # the attn-cotangent input exists only when the forward returned attn —
+    # the training path never allocates the (B, H, N, N) zeros tensor
+    if has_ga:
+        ga_ref, dq_ref, dk_ref, dv_ref, dqh_ref, dkh_ref, ds_ref = rest
+    else:
+        dq_ref, dk_ref, dv_ref, dqh_ref, dkh_ref, ds_ref = rest
+    q, k, v = q_ref[0, 0], k_ref[0, 0], v_ref[0, 0]
+    q_hat, k_hat, s = qh_ref[0, 0], kh_ref[0, 0], s_ref[0]
+    graph, p, attn, z = _chain(q, k, q_hat, k_hat, s, noise_ref[0, 0], pad_ref[...])
+    g_out = go_ref[0, 0]
+    g_attn_in = ga_ref[0, 0] if has_ga else 0.0
+
+    if rate > 0.0:
+        pid = pl.program_id(0) * pl.num_programs(1) + pl.program_id(1)
+        keep = _keep_mask(seed_ref[0], pid, attn.shape, rate) * (1.0 / (1.0 - rate))
+        attn_d = attn * keep
+        d_attn = jnp.dot(g_out, v.T, preferred_element_type=jnp.float32) * keep + g_attn_in
+    else:
+        attn_d = attn
+        d_attn = jnp.dot(g_out, v.T, preferred_element_type=jnp.float32) + g_attn_in
+    dv_ref[0, 0] = jnp.dot(attn_d.T, g_out, preferred_element_type=jnp.float32)
+
+    w_sum = jnp.sum(p * graph, axis=-1, keepdims=True)
+    live = (w_sum >= L1_EPS).astype(jnp.float32)
+    d_w = (d_attn - live * jnp.sum(d_attn * attn, axis=-1, keepdims=True)) / z
+
+    # graph cotangent: attention product + sparsity-regularizer scalar
+    d_graph = d_w * p + gs_ref[0, 0]
+    d_p = d_w * graph
+    d_sc = p * (d_p - jnp.sum(d_p * p, axis=-1, keepdims=True))
+    inv = 1.0 / math.sqrt(q.shape[-1])
+    dq_ref[0, 0] = jnp.dot(d_sc, k, preferred_element_type=jnp.float32) * inv
+    dk_ref[0, 0] = jnp.dot(d_sc.T, q, preferred_element_type=jnp.float32) * inv
+
+    # straight-through estimator (ref STE.py:17-19): hardtanh(A · g)
+    d_exp_a = jnp.clip(graph * d_graph, -1.0, 1.0)
+    dqh_ref[0, 0] = jnp.dot(
+        d_exp_a, jnp.dot(k_hat, s.T, preferred_element_type=jnp.float32),
+        preferred_element_type=jnp.float32,
+    )
+    dkh_ref[0, 0] = jnp.dot(
+        d_exp_a.T, jnp.dot(q_hat, s, preferred_element_type=jnp.float32),
+        preferred_element_type=jnp.float32,
+    )
+    ds_ref[0, 0] = jnp.dot(
+        q_hat.T, jnp.dot(d_exp_a, k_hat, preferred_element_type=jnp.float32),
+        preferred_element_type=jnp.float32,
+    )
+
+
+def _specs(b, h, n, dh, kk):
+    bh = lambda d: pl.BlockSpec((1, 1, n, d), lambda i, j: (i, j, 0, 0), memory_space=pltpu.VMEM)
+    return {
+        "seed": pl.BlockSpec(memory_space=pltpu.SMEM),
+        "qkv": bh(dh),
+        "hat": bh(kk),
+        "s": pl.BlockSpec((1, kk, kk), lambda i, j: (j, 0, 0), memory_space=pltpu.VMEM),
+        "nn": bh(n),
+        "pad": pl.BlockSpec((1, n), lambda i, j: (i, 0), memory_space=pltpu.VMEM),
+        "scalar": pl.BlockSpec((1, 1), lambda i, j: (i, j), memory_space=pltpu.VMEM),
+    }
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(9, 10))
+def _fused(q, k, v, q_hat, k_hat, s, noise, pad, seed_arr, rate, return_attn):
+    return _fwd_call(q, k, v, q_hat, k_hat, s, noise, pad, seed_arr, rate, return_attn)
+
+
+def _fwd_call(q, k, v, q_hat, k_hat, s, noise, pad, seed_arr, rate, return_attn):
+    b, h, n, dh = q.shape
+    kk = q_hat.shape[-1]
+    sp = _specs(b, h, n, dh, kk)
+    kernel = functools.partial(_fwd_kernel, rate=float(rate), return_attn=return_attn)
+    attn_n = n if return_attn else 8  # minimal tile when attn is unused
+    out, spars, attn = pl.pallas_call(
+        kernel,
+        grid=(b, h),
+        in_specs=[
+            sp["seed"], sp["qkv"], sp["qkv"], sp["qkv"],
+            sp["hat"], sp["hat"], sp["s"], sp["nn"], sp["pad"],
+        ],
+        out_specs=[
+            sp["qkv"], sp["scalar"],
+            pl.BlockSpec((1, 1, attn_n, attn_n), lambda i, j: (i, j, 0, 0), memory_space=pltpu.VMEM),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((b, h, n, dh), jnp.float32),
+            jax.ShapeDtypeStruct((b, h), jnp.float32),
+            jax.ShapeDtypeStruct((b, h, attn_n, attn_n), jnp.float32),
+        ],
+        cost_estimate=pl.CostEstimate(
+            flops=b * h * (6 * n * n * dh + 4 * n * n * kk + 12 * n * n),
+            bytes_accessed=b * h * (3 * n * dh + n * n + 2 * n * kk) * 4,
+            transcendentals=b * h * n * n,
+        ),
+        interpret=_interpret(),
+    )(seed_arr, q, k, v, q_hat, k_hat, s, noise, pad)
+    if not return_attn:
+        attn = None
+    return out, spars, attn
+
+
+def _vjp_fwd(q, k, v, q_hat, k_hat, s, noise, pad, seed_arr, rate, return_attn):
+    res = (q, k, v, q_hat, k_hat, s, noise, pad, seed_arr)
+    return _fwd_call(q, k, v, q_hat, k_hat, s, noise, pad, seed_arr, rate, return_attn), res
+
+
+def _vjp_bwd(rate, return_attn, res, cots):
+    q, k, v, q_hat, k_hat, s, noise, pad, seed_arr = res
+    g_out, g_spars, g_attn = cots
+    b, h, n, dh = q.shape
+    kk = q_hat.shape[-1]
+    has_ga = return_attn and g_attn is not None
+    sp = _specs(b, h, n, dh, kk)
+    kernel = functools.partial(_bwd_kernel, rate=float(rate), has_ga=has_ga)
+    in_specs = [
+        sp["seed"], sp["qkv"], sp["qkv"], sp["qkv"],
+        sp["hat"], sp["hat"], sp["s"], sp["nn"], sp["pad"],
+        sp["qkv"], sp["scalar"],
+    ]
+    inputs = [seed_arr, q, k, v, q_hat, k_hat, s, noise, pad, g_out, g_spars]
+    if has_ga:
+        in_specs.append(sp["nn"])
+        inputs.append(g_attn)
+    dq, dk, dv, dqh, dkh, ds_part = pl.pallas_call(
+        kernel,
+        grid=(b, h),
+        in_specs=in_specs,
+        out_specs=[
+            sp["qkv"], sp["qkv"], sp["qkv"], sp["hat"], sp["hat"],
+            pl.BlockSpec((1, 1, kk, kk), lambda i, j: (i, j, 0, 0), memory_space=pltpu.VMEM),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((b, h, n, dh), jnp.float32),
+            jax.ShapeDtypeStruct((b, h, n, dh), jnp.float32),
+            jax.ShapeDtypeStruct((b, h, n, dh), jnp.float32),
+            jax.ShapeDtypeStruct((b, h, n, kk), jnp.float32),
+            jax.ShapeDtypeStruct((b, h, n, kk), jnp.float32),
+            jax.ShapeDtypeStruct((b, h, kk, kk), jnp.float32),
+        ],
+        cost_estimate=pl.CostEstimate(
+            flops=b * h * (12 * n * n * dh + 10 * n * n * kk + 20 * n * n),
+            bytes_accessed=b * h * (6 * n * dh + n * n + 4 * n * kk) * 4,
+            transcendentals=b * h * n * n,
+        ),
+        interpret=_interpret(),
+    )(*inputs)
+    ds = jnp.sum(ds_part, axis=0)  # (H, K, K): accumulate batch partials
+    return (
+        dq, dk, dv, dqh, dkh, ds,
+        jnp.zeros_like(noise), jnp.zeros_like(pad),
+        np.zeros(seed_arr.shape, dtype=float0),
+    )
+
+
+_fused.defvjp(_vjp_fwd, _vjp_bwd)
+
+
+def sbm_attention_fused_pallas(
+    q: jnp.ndarray,       # (B, H, N, dh) fp32
+    k: jnp.ndarray,
+    v: jnp.ndarray,
+    q_hat: jnp.ndarray,   # (B, H, N, K) fp32 — soft cluster memberships
+    k_hat: jnp.ndarray,
+    s: jnp.ndarray,       # (H, K, K) fp32 — cluster affinity
+    noise: jnp.ndarray,   # (B, H, N, N) uniform(0,1) — the Bernoulli draw
+    key_pad: jnp.ndarray,  # (B, N), truthy = padded
+    dropout_rate: float = 0.0,
+    dropout_seed: Optional[jnp.ndarray] = None,
+    return_attn: bool = False,
+) -> Tuple[jnp.ndarray, jnp.ndarray, Optional[jnp.ndarray]]:
+    """Returns ``(out, graph_sums, attn?)`` — ``graph_sums`` is ``ΣA`` per
+    (batch, head); divide by ``B·N·N`` summed over batch for the
+    reference's per-head sparsity (``sbm_attn.py:64``)."""
+    pad = key_pad.astype(jnp.float32)
+    if dropout_seed is None:
+        seed_arr = jnp.zeros((1,), dtype=jnp.int32)
+    else:
+        seed_arr = jnp.asarray(dropout_seed, dtype=jnp.int32).reshape((1,))
+    return _fused(
+        q, k, v, q_hat, k_hat, s, noise, pad, seed_arr,
+        float(dropout_rate), bool(return_attn),
+    )

@@ -61,3 +61,18 @@ def test_remat_forward_and_grads_match(tiny_config):
     flat_f = jax.tree.leaves(outs[False][1])
     for a, b in zip(flat_t, flat_f):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=2e-4)
+
+
+def test_preprocess_ignore_idx_idempotent(tmp_path):
+    """Re-running filtering must not double-drop (filters from .raw snapshot)."""
+    from csat_tpu.data.extract import extract_corpus
+    from csat_tpu.data.preprocess import process_split
+
+    pairs = [(f"def f{i}(x):\n    return x + {i}", f"adds {i}") for i in range(5)]
+    d = str(tmp_path / "train")
+    extract_corpus(pairs, d, "python")
+    for _ in range(2):  # second run re-filters from the pristine snapshot
+        n = process_split(d, max_ast_len=32, ignore_idx=(1, 3))
+        assert n == 3
+        nls = [l for l in open(os.path.join(d, "nl.original")).read().split("\n") if l]
+        assert nls == ["adds 0", "adds 2", "adds 4"]
