@@ -76,8 +76,11 @@ def laplacian_pe(adj: jnp.ndarray, num_node: jnp.ndarray, pegen_dim: int) -> jnp
     lap = lap + pad_diag
     _, vecs = jnp.linalg.eigh(lap)  # ascending eigenvalues; (B, N, N) columns
     vecs = jnp.where(pair, vecs, 0.0)  # zero pad rows and pad-eigvec columns
+    # first min(n, pegen_dim) low-frequency eigenvectors, zero-padded right
+    # (the reference only ever runs n <= pegen_dim; this degrades gracefully)
+    keep = min(n, pegen_dim)
     out = jnp.zeros((b, n, pegen_dim), dtype=jnp.float32)
-    return out.at[:, :, :n].set(vecs)
+    return out.at[:, :, :keep].set(vecs[:, :, :keep])
 
 
 class TripletEmbedding(nn.Module):

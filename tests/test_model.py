@@ -145,3 +145,27 @@ def test_sparsity_value_range(tiny_config, synthetic_corpus):
     )
     assert 0.0 <= float(sparsity) <= 1.0
     assert pe.shape == (4, cfg.max_src_len, cfg.pe_dim)
+
+
+def test_all_pe_variants_train_step(tiny_config):
+    """Every PE variant (pegen/laplacian/sequential/treepos/triplet) must run
+    a jitted train step with finite loss (ref encode dispatch,
+    base_seq2seq.py:67-97)."""
+    import jax
+
+    from csat_tpu.data.toy import random_batch
+    from csat_tpu.train.loop import make_train_step
+    from csat_tpu.train.state import create_train_state, default_optimizer, make_model
+
+    for variant in ("pegen", "laplacian", "sequential", "treepos", "triplet"):
+        over = {"use_pegen": variant}
+        if variant == "sequential":
+            over.update(pe_dim=0, pegen_dim=0)
+        cfg = tiny_config.replace(**over)
+        batch = random_batch(cfg, 2, 50, 60, 30, seed=0)
+        model = make_model(cfg, 50, 60, 30)
+        tx = default_optimizer(cfg)
+        state = create_train_state(model, tx, batch, seed=0)
+        step = make_train_step(model, tx, cfg)
+        state, metrics = step(state, batch)
+        assert np.isfinite(float(metrics["loss"])), variant
