@@ -2,55 +2,84 @@
 
 Prints ONE JSON line:
     {"metric": "ast_nodes_per_sec_per_chip", "value": N, "unit": "nodes/s/chip",
-     "vs_baseline": R}
+     "vs_baseline": R, ...labels}
 
 Workload = the reference's default Python config (``config/python.py``):
 pegen CSE (4 disentangled-attention layers) + 4-layer SBM sparse-attention
 encoder + 4-layer decoder, batch 64, N=150 AST nodes — one full training
-step (forward, label-smoothed loss + sparsity regularizer, backward, AdamW).
-Throughput counts padded AST nodes (batch × max_src_len) per optimizer step,
+step (forward, label-smoothed loss + sparsity regularizer, backward, AdamW),
 matching the per-batch accounting of the reference's timing harness
-(``csa_trans_time_memory.py``).
+(``/root/reference/csa_trans_time_memory.py:96-158``).
 
-Execution-variant selection: the fastest of a small candidate set
-(XLA fp32 — always-safe baseline; bf16 compute with fp32 attention
-islands; fused Pallas kernels) is picked by a short timed probe on the
-actual device, then re-measured properly. A variant that fails to compile
-or produces a non-finite loss is discarded, so the benchmark always
-completes on the safe path. Set ``BENCH_VARIANTS=backend:dtype[,...]`` to
-pin the candidate list (e.g. ``BENCH_VARIANTS=xla:float32``).
+Engineered for hostile environments (round-1 lesson: the axon TPU plugin can
+hang ~25 min in backend init and eat the whole driver budget):
+
+* the parent process NEVER imports jax — every measurement runs in a
+  subprocess (its own process group) with a hard wall-clock timeout;
+* a persistent XLA compilation cache (``.jax_cache/``) amortizes compiles;
+* variants run best-first under a global budget (``BENCH_BUDGET_S``, default
+  1200s): xla:bf16 on the default (TPU) platform, then pallas:bf16 if budget
+  remains; on TPU failure a small forced-CPU run still produces a number;
+* the JSON line is ALWAYS emitted — degraded runs are labeled
+  ``"device": "cpu"`` / ``"degraded": true``.
 
 ``vs_baseline`` compares against the PyTorch reference implementation
-measured by ``tools/bench_torch_baseline.py`` on this host (stored in
-``baseline_torch.json``, with its device recorded there — CPU torch when no
-CUDA exists); 0.0 when no baseline measurement exists.
+measured by ``tools/bench_torch_baseline.py`` on this host
+(``baseline_torch.json``; a CPU-torch number when no CUDA exists — the
+ratio is a same-host sanity figure, NOT the v5e-vs-GPU north star; the
+baseline device is recorded in the output labels). 0.0 when no baseline.
 """
 
 from __future__ import annotations
 
 import json
 import os
+import signal
+import subprocess
 import sys
 import time
 
-import jax
-import numpy as np
-
-DEFAULT_VARIANTS = (
-    ("pallas", "bfloat16"),
-    ("xla", "bfloat16"),
-    ("xla", "float32"),
-)
+HERE = os.path.dirname(os.path.abspath(__file__))
+CACHE_DIR = os.path.join(HERE, ".jax_cache")
+BUDGET_S = float(os.environ.get("BENCH_BUDGET_S", "1200"))
+_T0 = time.monotonic()
 
 
-def _build(variant):
+def _remaining() -> float:
+    return BUDGET_S - (time.monotonic() - _T0)
+
+
+# --------------------------------------------------------------------------
+# child: one measured variant in an expendable process
+# --------------------------------------------------------------------------
+
+def _child(spec: str) -> None:
+    """Measure one variant; print a result JSON line on the last stdout line.
+
+    spec = "backend:dtype:platform:batch:steps", platform "default" or "cpu".
+    """
+    backend, dtype, platform, batch_size, n_steps = spec.split(":")
+    batch_size, n_steps = int(batch_size), int(n_steps)
+
+    if platform == "cpu":
+        os.environ["JAX_PLATFORMS"] = "cpu"
+    import jax
+
+    if platform == "cpu":
+        jax.config.update("jax_platforms", "cpu")  # axon ignores the env var
+    os.makedirs(CACHE_DIR, exist_ok=True)
+    jax.config.update("jax_compilation_cache_dir", CACHE_DIR)
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+
+    import numpy as np
+
     from csat_tpu.configs import get_config
     from csat_tpu.data.toy import random_batch
     from csat_tpu.train.loop import make_train_step
     from csat_tpu.train.state import create_train_state, default_optimizer, make_model
 
-    backend, dtype = variant
-    cfg = get_config("python", batch_size=64, backend=backend, compute_dtype=dtype)
+    cfg = get_config("python", batch_size=batch_size, backend=backend,
+                     compute_dtype=dtype)
     src_v, tgt_v, trip_v = 10_000, 20_000, 1246
     batch = random_batch(cfg, cfg.batch_size, src_v, tgt_v, trip_v, seed=0)
     batch = jax.tree.map(jax.device_put, batch)
@@ -58,74 +87,161 @@ def _build(variant):
     tx = default_optimizer(cfg)
     state = create_train_state(model, tx, batch, seed=cfg.seed)
     step = make_train_step(model, tx, cfg)
-    return cfg, state, batch, step
 
+    t_compile = time.perf_counter()
+    state, metrics = step(state, batch)  # compile + warmup
+    loss = float(jax.block_until_ready(metrics["loss"]))
+    t_compile = time.perf_counter() - t_compile
+    if not np.isfinite(loss):
+        raise FloatingPointError(f"non-finite loss {loss}")
 
-def _time_steps(state, batch, step, n_steps):
     t0 = time.perf_counter()
     for _ in range(n_steps):
         state, metrics = step(state, batch)
-    jax.block_until_ready(metrics["loss"])
-    return time.perf_counter() - t0, state, float(metrics["loss"])
+    loss = float(jax.block_until_ready(metrics["loss"]))
+    dt = time.perf_counter() - t0
+
+    n_chips = jax.device_count()
+    nodes = cfg.batch_size * cfg.max_src_len * n_steps
+    print(json.dumps({
+        "ok": True,
+        "backend": backend,
+        "dtype": dtype,
+        "device": jax.devices()[0].platform,
+        "n_chips": n_chips,
+        "loss": round(loss, 4),
+        "compile_s": round(t_compile, 1),
+        "steps": n_steps,
+        "step_ms": round(dt / n_steps * 1e3, 2),
+        "nodes_per_sec_per_chip": nodes / dt / n_chips,
+    }))
+
+
+# --------------------------------------------------------------------------
+# parent: orchestration, hard timeouts, guaranteed JSON emission
+# --------------------------------------------------------------------------
+
+def _run_variant(spec: str, timeout_s: float):
+    """Run one child with a hard timeout, killing its whole process group."""
+    if timeout_s < 30:
+        return None, "budget exhausted"
+    proc = subprocess.Popen(
+        [sys.executable, os.path.abspath(__file__), "--child", spec],
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+        start_new_session=True, cwd=HERE,
+    )
+    try:
+        out, err = proc.communicate(timeout=timeout_s)
+    except subprocess.TimeoutExpired:
+        try:
+            os.killpg(proc.pid, signal.SIGKILL)
+        except ProcessLookupError:
+            pass
+        proc.wait()
+        return None, f"timeout after {timeout_s:.0f}s"
+    if proc.returncode != 0:
+        tail = (err or "").strip().splitlines()[-3:]
+        return None, f"rc={proc.returncode}: {' | '.join(tail)}"
+    for line in reversed((out or "").strip().splitlines()):
+        try:
+            rec = json.loads(line)
+            if rec.get("ok"):
+                return rec, None
+        except json.JSONDecodeError:
+            continue
+    return None, "no result line in child output"
 
 
 def main() -> None:
     env = os.environ.get("BENCH_VARIANTS", "")
     if env:
-        variants = tuple(tuple(v.split(":")) for v in env.split(","))
+        variants = [tuple(v.split(":")) for v in env.split(",")
+                    if len(v.split(":")) == 2]
     else:
-        variants = DEFAULT_VARIANTS
+        variants = [("xla", "bfloat16"), ("pallas", "bfloat16"),
+                    ("xla", "float32")]
 
-    results = {}
-    compiled = {}
-    for variant in variants:
-        try:
-            cfg, state, batch, step = _build(variant)
-            # compile + warmup, then a short probe
-            state, metrics = step(state, batch)
-            loss = float(jax.block_until_ready(metrics["loss"]))
-            if not np.isfinite(loss):
-                raise FloatingPointError(f"non-finite loss {loss}")
-            dt, state, loss = _time_steps(state, batch, step, 3)
-            results[variant] = dt
-            compiled[variant] = (cfg, state, batch, step)
-        except Exception as e:  # noqa: BLE001 — any failure discards the variant
-            print(f"# variant {variant} skipped: {type(e).__name__}: {e}", file=sys.stderr)
+    results, notes = [], []
+    for i, (backend, dtype) in enumerate(variants):
+        # first variant gets the lion's share (it may pay TPU init + compile);
+        # later ones reuse the warm compilation cache
+        reserve = 240 if not results else 60  # keep room for the CPU fallback
+        timeout_s = min(_remaining() - reserve, 900 if i == 0 else 420)
+        rec, err = _run_variant(f"{backend}:{dtype}:default:64:20", timeout_s)
+        if rec:
+            results.append(rec)
+        else:
+            notes.append(f"{backend}:{dtype} failed ({err})")
+            print(f"# variant {backend}:{dtype} skipped: {err}", file=sys.stderr)
+            if i == 0 and err and err.startswith("timeout"):
+                break  # backend init hang — the platform itself is unusable
+
+    degraded = False
     if not results:
-        raise SystemExit("no benchmark variant compiled")
+        degraded = True
+        rec, err = _run_variant(
+            "xla:float32:cpu:8:3", min(_remaining() - 30, 420))
+        if rec:
+            results.append(rec)
+        else:
+            notes.append(f"cpu fallback failed ({err})")
+            print(f"# cpu fallback failed: {err}", file=sys.stderr)
 
-    best = min(results, key=results.get)
-    cfg, state, batch, step = compiled[best]
-    n_steps = 20
-    dt, state, loss = _time_steps(state, batch, step, n_steps)
-
-    n_chips = jax.device_count()
-    nodes = cfg.batch_size * cfg.max_src_len * n_steps
-    nodes_per_sec_per_chip = nodes / dt / n_chips
-
-    baseline = 0.0
-    base_path = os.path.join(os.path.dirname(os.path.abspath(__file__)), "baseline_torch.json")
-    if os.path.exists(base_path):
+    baseline, baseline_device = 0.0, None
+    base_path = os.path.join(HERE, "baseline_torch.json")
+    try:
         with open(base_path) as f:
-            baseline = float(json.load(f).get("ast_nodes_per_sec_per_chip", 0.0))
-    vs = nodes_per_sec_per_chip / baseline if baseline > 0 else 0.0
+            base = json.load(f)
+        baseline = float(base.get("ast_nodes_per_sec_per_chip", 0.0))
+        baseline_device = base.get("device")
+    except (OSError, ValueError):
+        pass
 
-    print(
-        f"# variant={best[0]}:{best[1]} loss={loss:.3f} "
-        f"probe={ {f'{b}:{d}': round(t, 2) for (b, d), t in results.items()} }",
-        file=sys.stderr,
-    )
-    print(
-        json.dumps(
-            {
-                "metric": "ast_nodes_per_sec_per_chip",
-                "value": round(nodes_per_sec_per_chip, 1),
-                "unit": "nodes/s/chip",
-                "vs_baseline": round(vs, 3),
-            }
-        )
-    )
+    if results:
+        best = max(results, key=lambda r: r["nodes_per_sec_per_chip"])
+        value = best["nodes_per_sec_per_chip"]
+        out = {
+            "metric": "ast_nodes_per_sec_per_chip",
+            "value": round(value, 1),
+            "unit": "nodes/s/chip",
+            "vs_baseline": round(value / baseline, 3) if baseline > 0 else 0.0,
+            "backend": best["backend"],
+            "dtype": best["dtype"],
+            "device": best["device"],
+            "step_ms": best["step_ms"],
+            "baseline_device": baseline_device,
+        }
+        if degraded:
+            out["degraded"] = True
+        if notes:
+            out["notes"] = "; ".join(notes)
+        for r in results:
+            print(f"# {r['backend']}:{r['dtype']} on {r['device']}: "
+                  f"{r['nodes_per_sec_per_chip']:.0f} nodes/s/chip "
+                  f"(step {r['step_ms']}ms, compile {r['compile_s']}s, "
+                  f"loss {r['loss']})", file=sys.stderr)
+    else:
+        out = {
+            "metric": "ast_nodes_per_sec_per_chip",
+            "value": 0.0,
+            "unit": "nodes/s/chip",
+            "vs_baseline": 0.0,
+            "degraded": True,
+            "notes": "; ".join(notes) or "all variants failed",
+        }
+    print(json.dumps(out))
 
 
 if __name__ == "__main__":
-    main()
+    if len(sys.argv) > 2 and sys.argv[1] == "--child":
+        _child(sys.argv[2])
+    else:
+        try:
+            main()
+        except Exception as e:  # noqa: BLE001 — the JSON line must ALWAYS appear
+            print(f"# bench driver error: {type(e).__name__}: {e}", file=sys.stderr)
+            print(json.dumps({
+                "metric": "ast_nodes_per_sec_per_chip", "value": 0.0,
+                "unit": "nodes/s/chip", "vs_baseline": 0.0,
+                "degraded": True, "notes": f"driver error: {type(e).__name__}: {e}",
+            }))
