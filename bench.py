@@ -154,14 +154,20 @@ def _run_variant(spec: str, timeout_s: float):
 
 def main() -> None:
     env = os.environ.get("BENCH_VARIANTS", "")
+    notes = []
     if env:
-        variants = [tuple(v.split(":")) for v in env.split(",")
-                    if len(v.split(":")) == 2]
+        variants = []
+        for v in env.split(","):
+            parts = v.split(":")
+            if len(parts) == 2:
+                variants.append(tuple(parts))
+            else:
+                notes.append(f"ignored malformed BENCH_VARIANTS entry {v!r}")
     else:
         variants = [("xla", "bfloat16"), ("pallas", "bfloat16"),
                     ("xla", "float32")]
 
-    results, notes = [], []
+    results = []
     for i, (backend, dtype) in enumerate(variants):
         # first variant gets the lion's share (it may pay TPU init + compile);
         # later ones reuse the warm compilation cache
