@@ -58,7 +58,7 @@ def load_meteor() -> Optional[ctypes.CDLL]:
                 os.unlink(tmp)
                 return None
         lib = ctypes.CDLL(lib_path)
-        lib.meteor_score_c.argtypes = [ctypes.c_char_p, ctypes.c_char_p]
+        lib.meteor_score_c.argtypes = [ctypes.c_char_p, ctypes.c_char_p, ctypes.c_int]
         lib.meteor_score_c.restype = ctypes.c_double
         _LIB = lib
     except OSError:
@@ -68,9 +68,15 @@ def load_meteor() -> Optional[ctypes.CDLL]:
     return _LIB
 
 
-def native_meteor_score(hyp: str, ref: str) -> Optional[float]:
-    """Score via the C++ library; None when it is unavailable."""
+def native_meteor_score(hyp: str, ref: str, version: str = "1.5") -> Optional[float]:
+    """Score via the C++ library; None when it is unavailable.
+
+    ``version`` selects the METEOR-1.5 (normalize+stem) or classic 2005
+    exact-match formulation — see ``csat_tpu/metrics/meteor.py``.
+    """
     lib = load_meteor()
     if lib is None:
         return None
-    return float(lib.meteor_score_c(hyp.encode(), ref.encode()))
+    return float(
+        lib.meteor_score_c(hyp.encode(), ref.encode(), 1 if version == "1.5" else 0)
+    )

@@ -1,27 +1,41 @@
-// METEOR-lite scorer (exact-match module), native implementation.
+// METEOR scorer, native implementation (exact + Porter-stem alignment,
+// METEOR-1.5 English parameters; classic 2005 exact-match mode retained).
 //
 // The reference runs METEOR as a JVM subprocess over a stdio line protocol
 // (/root/reference/valid_metrices/meteor/meteor.py:192-290, jar absent).
-// This library provides the same capability natively: unigram exact-match
-// alignment maximizing matches then minimizing chunk count (branch-and-bound,
-// greedy fallback past a node cap — semantics identical to
-// csat_tpu/metrics/meteor.py, which differential tests hold to this),
-// Fmean = 10PR/(R+9P), penalty 0.5*(chunks/m)^3.
+// This library provides the same capability natively. Semantics are held
+// identical to csat_tpu/metrics/meteor.py by differential tests:
 //
-// Exposed via a C ABI for ctypes:  double meteor_score_c(hyp, ref)
-// where hyp/ref are whitespace-tokenized UTF-8 strings.
+//   * one-to-one alignment maximizing (matches, module weight, -chunks)
+//     lexicographically via branch-and-bound (adjacent-first, exact-before-
+//     stem ordering; on node-cap the best *complete* solution found so far
+//     is used, so the (matches, chunks) pair is always consistent);
+//   * METEOR-1.5 English parameters alpha=.85 beta=.2 gamma=.6 delta=.75,
+//     module weights exact=1.0 stem=0.6, content/function-word weighting;
+//   * Porter (1980) stemmer (the jar uses Snowball English — documented
+//     delta in the Python module docstring).
+//
+// Inputs arrive pre-normalized (lowercase, punctuation split) from the
+// Python wrapper as whitespace-joined UTF-8 token strings.
+//
+// Exposed via a C ABI for ctypes:
+//   double meteor_score_c(const char* hyp, const char* ref, int v15)
 //
 // Build:  g++ -O2 -shared -fPIC -o libmeteor.so meteor.cpp
 
 #include <algorithm>
+#include <cmath>
 #include <cstring>
 #include <limits>
-#include <map>
+#include <set>
 #include <sstream>
 #include <string>
 #include <vector>
 
 namespace {
+
+constexpr double ALPHA = 0.85, BETA = 0.2, GAMMA = 0.6, DELTA = 0.75;
+constexpr double W_EXACT = 1.0, W_STEM = 0.6;
 
 std::vector<std::string> tokenize(const char* s) {
     std::vector<std::string> out;
@@ -31,121 +45,361 @@ std::vector<std::string> tokenize(const char* s) {
     return out;
 }
 
+const std::set<std::string>& function_words() {
+    // mirror of csat_tpu/metrics/meteor.py FUNCTION_WORDS
+    static const std::set<std::string> words = [] {
+        const char* raw =
+            "a an the and or but nor so yet for of in on at by to from with "
+            "without into onto upon about above below under over between "
+            "among through during before after since until against within "
+            "along across behind beyond near off out up down is am are was "
+            "were be been being do does did done have has had having will "
+            "would shall should can could may might must ought i you he she "
+            "it we they me him her us them my your his its our their mine "
+            "yours hers ours theirs this that these those who whom whose "
+            "which what as if then than when while where why how not no any "
+            "some each every either neither both all most more less few much "
+            "many own same such only very too also just there here "
+            ". , ; : ! ? ' \" ` ( ) [ ] { } - -- ... </s> <s> <pad> <unk> "
+            "<???>";
+        std::set<std::string> w;
+        for (const auto& t : tokenize(raw)) w.insert(t);
+        return w;
+    }();
+    return words;
+}
+
+// ------------------------------------------------------------------
+// Porter (1980) stemmer — mirror of csat_tpu/metrics/meteor.py
+// ------------------------------------------------------------------
+
+bool is_cons(const std::string& w, int i) {
+    char c = w[i];
+    if (c == 'a' || c == 'e' || c == 'i' || c == 'o' || c == 'u') return false;
+    if (c == 'y') return i == 0 || !is_cons(w, i - 1);
+    return true;
+}
+
+int measure(const std::string& stem) {
+    int m = 0;
+    bool prev_v = false;
+    for (int i = 0; i < (int)stem.size(); ++i) {
+        bool v = !is_cons(stem, i);
+        if (!v && prev_v) ++m;  // count v->c transitions
+        prev_v = v;
+    }
+    return m;
+}
+
+bool has_vowel(const std::string& stem) {
+    for (int i = 0; i < (int)stem.size(); ++i)
+        if (!is_cons(stem, i)) return true;
+    return false;
+}
+
+bool ends_double_cons(const std::string& w) {
+    int n = (int)w.size();
+    return n >= 2 && w[n - 1] == w[n - 2] && is_cons(w, n - 1);
+}
+
+bool ends_cvc(const std::string& w) {
+    int n = (int)w.size();
+    if (n < 3) return false;
+    if (!(is_cons(w, n - 3) && !is_cons(w, n - 2) && is_cons(w, n - 1)))
+        return false;
+    char c = w[n - 1];
+    return c != 'w' && c != 'x' && c != 'y';
+}
+
+bool ends_with(const std::string& w, const std::string& suf) {
+    return w.size() >= suf.size() &&
+           w.compare(w.size() - suf.size(), suf.size(), suf) == 0;
+}
+
+bool all_alpha(const std::string& w) {
+    for (char c : w)
+        if (c < 'a' || c > 'z') return false;
+    return true;
+}
+
+std::string porter_stem(const std::string& word) {
+    std::string w = word;
+    if (w.size() <= 2 || !all_alpha(w)) return w;
+
+    // Step 1a
+    if (ends_with(w, "sses")) w.resize(w.size() - 2);
+    else if (ends_with(w, "ies")) w.resize(w.size() - 2);
+    else if (ends_with(w, "ss")) {}
+    else if (ends_with(w, "s")) w.resize(w.size() - 1);
+
+    // Step 1b
+    bool flag_1b = false;
+    if (ends_with(w, "eed")) {
+        if (measure(w.substr(0, w.size() - 3)) > 0) w.resize(w.size() - 1);
+    } else if (ends_with(w, "ed")) {
+        if (has_vowel(w.substr(0, w.size() - 2))) {
+            w.resize(w.size() - 2);
+            flag_1b = true;
+        }
+    } else if (ends_with(w, "ing")) {
+        if (has_vowel(w.substr(0, w.size() - 3))) {
+            w.resize(w.size() - 3);
+            flag_1b = true;
+        }
+    }
+    if (flag_1b) {
+        if (ends_with(w, "at") || ends_with(w, "bl") || ends_with(w, "iz"))
+            w += "e";
+        else if (ends_double_cons(w) && !ends_with(w, "l") &&
+                 !ends_with(w, "s") && !ends_with(w, "z"))
+            w.resize(w.size() - 1);
+        else if (measure(w) == 1 && ends_cvc(w))
+            w += "e";
+    }
+
+    // Step 1c
+    if (ends_with(w, "y") && has_vowel(w.substr(0, w.size() - 1)))
+        w[w.size() - 1] = 'i';
+
+    // Step 2
+    static const std::pair<const char*, const char*> step2[] = {
+        {"ational", "ate"}, {"tional", "tion"}, {"enci", "ence"},
+        {"anci", "ance"}, {"izer", "ize"}, {"abli", "able"}, {"alli", "al"},
+        {"entli", "ent"}, {"eli", "e"}, {"ousli", "ous"}, {"ization", "ize"},
+        {"ation", "ate"}, {"ator", "ate"}, {"alism", "al"},
+        {"iveness", "ive"}, {"fulness", "ful"}, {"ousness", "ous"},
+        {"aliti", "al"}, {"iviti", "ive"}, {"biliti", "ble"}};
+    for (const auto& [suf, rep] : step2) {
+        if (ends_with(w, suf)) {
+            std::string stem = w.substr(0, w.size() - strlen(suf));
+            if (measure(stem) > 0) w = stem + rep;
+            break;
+        }
+    }
+
+    // Step 3
+    static const std::pair<const char*, const char*> step3[] = {
+        {"icate", "ic"}, {"ative", ""}, {"alize", "al"}, {"iciti", "ic"},
+        {"ical", "ic"}, {"ful", ""}, {"ness", ""}};
+    for (const auto& [suf, rep] : step3) {
+        if (ends_with(w, suf)) {
+            std::string stem = w.substr(0, w.size() - strlen(suf));
+            if (measure(stem) > 0) w = stem + rep;
+            break;
+        }
+    }
+
+    // Step 4 (longest suffix first, mirroring the Python sort)
+    static const std::vector<std::string> step4 = [] {
+        std::vector<std::string> s = {"al",   "ance", "ence", "er",  "ic",
+                                      "able", "ible", "ant",  "ement", "ment",
+                                      "ent",  "ion",  "ou",   "ism", "ate",
+                                      "iti",  "ous",  "ive",  "ize"};
+        std::stable_sort(s.begin(), s.end(),
+                         [](const std::string& a, const std::string& b) {
+                             return a.size() > b.size();
+                         });
+        return s;
+    }();
+    for (const auto& suf : step4) {
+        if (ends_with(w, suf)) {
+            std::string stem = w.substr(0, w.size() - suf.size());
+            if (measure(stem) > 1) {
+                if (suf == "ion" &&
+                    !(ends_with(stem, "s") || ends_with(stem, "t")))
+                    break;
+                w = stem;
+            }
+            break;
+        }
+    }
+
+    // Step 5a
+    if (ends_with(w, "e")) {
+        std::string stem = w.substr(0, w.size() - 1);
+        int m = measure(stem);
+        if (m > 1 || (m == 1 && !ends_cvc(stem))) w = stem;
+    }
+    // Step 5b
+    if (measure(w) > 1 && ends_double_cons(w) && ends_with(w, "l"))
+        w.resize(w.size() - 1);
+    return w;
+}
+
+// ------------------------------------------------------------------
+// Alignment: max matches, then max weight, then min chunks
+// ------------------------------------------------------------------
+
+struct Pair3 {
+    int i, j;
+    double w;
+};
+
 struct Aligner {
     const std::vector<std::string>& hyp;
     const std::vector<std::string>& ref;
-    std::map<std::string, int> quota;                    // per-type matches required
-    std::map<std::string, std::vector<int>> positions;   // ref positions per type
-    std::vector<std::map<std::string, int>> remaining;   // hyp occurrences at >= i
+    std::vector<std::vector<std::pair<int, double>>> edges;
     std::vector<char> used;
+    std::vector<Pair3> cur;
     long node_cap, nodes = 0;
-    int best = std::numeric_limits<int>::max();
+
+    bool have_best = false;
+    int best_matches = 0, best_chunks = 0;
+    double best_weight = 0.0;
+    std::vector<Pair3> best_pairs;
 
     Aligner(const std::vector<std::string>& h, const std::vector<std::string>& r,
-            long cap)
+            bool use_stem, long cap)
         : hyp(h), ref(r), node_cap(cap) {
-        std::map<std::string, int> h_cnt, r_cnt;
-        for (auto& t : hyp) h_cnt[t]++;
-        for (auto& t : ref) r_cnt[t]++;
-        for (auto& [t, c] : h_cnt)
-            if (r_cnt.count(t)) quota[t] = std::min(c, r_cnt[t]);
-        for (size_t j = 0; j < ref.size(); ++j)
-            if (quota.count(ref[j])) positions[ref[j]].push_back((int)j);
-        remaining.assign(hyp.size() + 1, {});
-        for (int i = (int)hyp.size() - 1; i >= 0; --i) {
-            remaining[i] = remaining[i + 1];
-            remaining[i][hyp[i]]++;
+        std::vector<std::string> hs, rs;
+        if (use_stem) {
+            for (const auto& t : h) hs.push_back(porter_stem(t));
+            for (const auto& t : r) rs.push_back(porter_stem(t));
         }
-        used.assign(ref.size(), 0);
-    }
-
-    int matches() const {
-        int m = 0;
-        for (auto& [t, q] : quota) m += q;
-        return m;
-    }
-
-    void dfs(size_t i, std::map<std::string, int>& need, int chunks, int prev) {
-        if (chunks >= best || nodes > node_cap) return;
-        if (i == hyp.size()) { best = chunks; return; }
-        ++nodes;
-        const std::string& tok = hyp[i];
-        auto it = need.find(tok);
-        int left = it == need.end() ? 0 : it->second;
-        if (left > 0) {
-            std::vector<int> cands;
-            for (int j : positions[tok]) if (!used[j]) cands.push_back(j);
-            // adjacent-first ordering finds low-chunk solutions early
-            std::stable_sort(cands.begin(), cands.end(), [&](int a, int b) {
-                return (a != prev + 1) < (b != prev + 1) || ((a != prev + 1) == (b != prev + 1) && a < b);
-            });
-            for (int j : cands) {
-                used[j] = 1;
-                it->second = left - 1;
-                dfs(i + 1, need, chunks + (j != prev + 1 ? 1 : 0), j);
-                it->second = left;
-                used[j] = 0;
+        edges.resize(h.size());
+        for (size_t i = 0; i < h.size(); ++i)
+            for (size_t j = 0; j < r.size(); ++j) {
+                if (h[i] == r[j])
+                    edges[i].push_back({(int)j, W_EXACT});
+                else if (use_stem && hs[i] == rs[j])
+                    edges[i].push_back({(int)j, W_STEM});
             }
-        }
-        auto rem = remaining[i + 1].find(tok);
-        int later = rem == remaining[i + 1].end() ? 0 : rem->second;
-        if (left == 0 || later >= left) dfs(i + 1, need, chunks, -2);
+        used.assign(r.size(), 0);
     }
 
-    // adjacency-preferring greedy fallback (mirrors _greedy_align)
-    int greedy_chunks() {
+    bool candidate_better(int m, double w, int ch) const {
+        if (!have_best) return true;
+        if (m != best_matches) return m > best_matches;
+        if (w != best_weight) return w > best_weight;
+        return ch < best_chunks;
+    }
+
+    void dfs(int i, int matches, double weight, int chunks, int prev) {
+        if (nodes > node_cap) return;
+        int rem = (int)hyp.size() - i;
+        if (have_best) {
+            if (matches + rem < best_matches) return;
+            if (matches + rem == best_matches &&
+                weight + rem * W_EXACT < best_weight)
+                return;
+            if (matches + rem == best_matches &&
+                weight + rem * W_EXACT == best_weight && chunks >= best_chunks)
+                return;
+        }
+        if (i == (int)hyp.size()) {
+            if (candidate_better(matches, weight, chunks)) {
+                have_best = true;
+                best_matches = matches;
+                best_weight = weight;
+                best_chunks = chunks;
+                best_pairs = cur;
+            }
+            return;
+        }
+        ++nodes;
+        std::vector<std::pair<int, double>> cands;
+        for (const auto& e : edges[i])
+            if (!used[e.first]) cands.push_back(e);
+        std::stable_sort(cands.begin(), cands.end(),
+                         [&](const std::pair<int, double>& a,
+                             const std::pair<int, double>& b) {
+                             bool aa = a.first != prev + 1, bb = b.first != prev + 1;
+                             if (aa != bb) return aa < bb;
+                             if (a.second != b.second) return a.second > b.second;
+                             return a.first < b.first;
+                         });
+        for (const auto& [j, w] : cands) {
+            used[j] = 1;
+            cur.push_back({i, j, w});
+            dfs(i + 1, matches + 1, weight + w,
+                chunks + (j != prev + 1 ? 1 : 0), j);
+            cur.pop_back();
+            used[j] = 0;
+        }
+        dfs(i + 1, matches, weight, chunks, -2);
+    }
+
+    // iterative adjacent-first greedy pass — the long-input path, mirror
+    // of csat_tpu/metrics/meteor.py _greedy_align
+    void run_greedy() {
         std::fill(used.begin(), used.end(), 0);
-        int chunks = 0, prev = -2;
-        for (auto& tok : hyp) {
-            int bestj = -1;
-            if (prev + 1 >= 0 && prev + 1 < (int)ref.size() && !used[prev + 1] &&
-                ref[prev + 1] == tok)
-                bestj = prev + 1;
-            else
-                for (size_t j = 0; j < ref.size(); ++j)
-                    if (!used[j] && ref[j] == tok) { bestj = (int)j; break; }
-            if (bestj >= 0) {
-                used[bestj] = 1;
-                if (bestj != prev + 1) ++chunks;
-                prev = bestj;
-            } else
+        best_pairs.clear();
+        best_weight = 0.0;
+        best_chunks = 0;
+        int prev = -2;
+        for (int i = 0; i < (int)hyp.size(); ++i) {
+            std::vector<std::pair<int, double>> cands;
+            for (const auto& e : edges[i])
+                if (!used[e.first]) cands.push_back(e);
+            std::stable_sort(cands.begin(), cands.end(),
+                             [&](const std::pair<int, double>& a,
+                                 const std::pair<int, double>& b) {
+                                 bool aa = a.first != prev + 1,
+                                      bb = b.first != prev + 1;
+                                 if (aa != bb) return aa < bb;
+                                 if (a.second != b.second)
+                                     return a.second > b.second;
+                                 return a.first < b.first;
+                             });
+            if (cands.empty()) {
                 prev = -2;
+                continue;
+            }
+            auto [j, w] = cands[0];
+            used[j] = 1;
+            best_pairs.push_back({i, j, w});
+            best_chunks += j != prev + 1 ? 1 : 0;
+            best_weight += w;
+            prev = j;
         }
-        return chunks;
+        best_matches = (int)best_pairs.size();
+        have_best = true;
     }
 
-    // returns {matches, min chunks}
-    std::pair<int, int> run() {
-        int m = matches();
-        if (m == 0) return {0, 0};
-        std::map<std::string, int> need = quota;
-        dfs(0, need, 0, -2);
-        if (nodes > node_cap || best == std::numeric_limits<int>::max()) {
-            int g = greedy_chunks();
-            if (best != std::numeric_limits<int>::max()) g = std::min(g, best);
-            return {m, g};
-        }
-        return {m, best};
+    void run() {
+        if (hyp.size() > 256 || ref.size() > 256)
+            run_greedy();
+        else
+            dfs(0, 0, 0.0, 0, -2);
     }
 };
+
+double content_weight(const std::string& tok) {
+    return function_words().count(tok) ? 1.0 - DELTA : DELTA;
+}
 
 }  // namespace
 
 extern "C" {
 
-double meteor_score_c(const char* hyp_s, const char* ref_s) {
+double meteor_score_c(const char* hyp_s, const char* ref_s, int v15) {
     auto hyp = tokenize(hyp_s);
     auto ref = tokenize(ref_s);
     if (hyp.empty() || ref.empty()) return 0.0;
-    Aligner a(hyp, ref, 20000);
-    auto [m, chunks] = a.run();
+    Aligner a(hyp, ref, /*use_stem=*/v15 != 0, 30000);
+    a.run();
+    int m = a.best_matches;
     if (m == 0) return 0.0;
+    if (v15) {
+        double wl_h = 0, wl_r = 0, wm_h = 0, wm_r = 0;
+        for (const auto& t : hyp) wl_h += content_weight(t);
+        for (const auto& t : ref) wl_r += content_weight(t);
+        for (const auto& pr : a.best_pairs) {
+            wm_h += pr.w * content_weight(hyp[pr.i]);
+            wm_r += pr.w * content_weight(ref[pr.j]);
+        }
+        double p = wl_h > 0 ? wm_h / wl_h : 0.0;
+        double r = wl_r > 0 ? wm_r / wl_r : 0.0;
+        if (p + r == 0.0) return 0.0;
+        double fmean = p * r / (ALPHA * p + (1.0 - ALPHA) * r);
+        double frag = (double)a.best_chunks / m;
+        return fmean * (1.0 - GAMMA * std::pow(frag, BETA));
+    }
     double p = (double)m / hyp.size();
     double r = (double)m / ref.size();
     double fmean = 10.0 * p * r / (r + 9.0 * p);
-    double frac = (double)chunks / m;
-    double penalty = 0.5 * frac * frac * frac;
-    return fmean * (1.0 - penalty);
+    double frac = (double)a.best_chunks / m;
+    return fmean * (1.0 - 0.5 * frac * frac * frac);
 }
 
 }  // extern "C"

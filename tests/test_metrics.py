@@ -65,14 +65,58 @@ def test_rouge_l():
     assert mean == pytest.approx(1.0) and arr.shape == (1,)
 
 
-def test_meteor():
-    assert meteor_score("a b c".split(), "a b c".split()) == pytest.approx(0.5 * 2 * (1 - 0.5 * (1 / 3) ** 3) + 0.0, abs=1.0)
+def test_meteor_2005():
     # perfect match: P=R=1, Fmean=1, chunks=1, penalty=0.5/m³-scaled
-    m = meteor_score(["x", "y", "z"], ["x", "y", "z"])
+    m = meteor_score(["x", "y", "z"], ["x", "y", "z"], version="2005")
     assert m == pytest.approx(1.0 * (1 - 0.5 * (1 / 3) ** 3))
-    assert meteor_score(["a"], ["b"]) == 0.0
+    assert meteor_score(["a"], ["b"], version="2005") == 0.0
+
+
+def test_meteor_15_formula():
+    # perfect 3-content-word match: P=R=1, Fmean=1, chunks=1, m=3 →
+    # penalty = 0.6·(1/3)^0.2  (METEOR-1.5 English parameters)
+    m = meteor_score(["cats", "chase", "mice"], ["cats", "chase", "mice"])
+    assert m == pytest.approx(1.0 - 0.6 * (1 / 3) ** 0.2)
+    assert meteor_score(["zebra"], ["yak"]) == 0.0
     mean, arr = Meteor().compute_score({0: ["x y"]}, {0: ["x y"]})
-    assert mean > 0.9
+    assert mean == pytest.approx(1.0 - 0.6 * (1 / 2) ** 0.2)
+
+
+def test_meteor_stem_matching():
+    """Stem matches (weight 0.6) score above zero but below exact matches."""
+    exact = meteor_score(["running"], ["running"])
+    stemmed = meteor_score(["running"], ["runs"])  # both stem to "run"
+    assert 0.0 < stemmed < exact
+    # the 2005 exact-only mode sees no match at all
+    assert meteor_score(["running"], ["runs"], version="2005") == 0.0
+
+
+def test_meteor_normalization():
+    """-norm behavior: case-insensitive, punctuation split off."""
+    assert meteor_score(["Sorts", "items."], ["sorts", "items"]) > 0.4
+    # without normalization ("2005") neither token matches exactly
+    assert meteor_score(["Sorts", "items."], ["sorts", "items"],
+                        version="2005") == 0.0
+    from csat_tpu.metrics.meteor import normalize_tokens
+
+    assert normalize_tokens(["Sorts", "items."]) == ["sorts", "items", "."]
+    assert normalize_tokens(["<s>", "don't"]) == ["<s>", "don", "'", "t"]
+
+
+def test_porter_stem_known_values():
+    from csat_tpu.metrics.meteor import porter_stem
+
+    known = {
+        "caresses": "caress", "ponies": "poni", "cats": "cat",
+        "agreed": "agre", "plastered": "plaster", "motoring": "motor",
+        "hopping": "hop", "falling": "fall", "happy": "happi", "sky": "sky",
+        "relational": "relat", "conditional": "condit",
+        "formalize": "formal", "hopeful": "hope", "goodness": "good",
+        "adjustment": "adjust", "adoption": "adopt", "effective": "effect",
+        "probate": "probat", "cease": "ceas", "the": "the",
+    }
+    for word, stem in known.items():
+        assert porter_stem(word) == stem, (word, porter_stem(word), stem)
 
 
 def test_output_transform_edges():
@@ -106,13 +150,18 @@ def test_native_meteor_matches_python():
 
         pytest.skip("native toolchain unavailable")
     rng = random.Random(0)
-    vocab = ["the", "cat", "sat", "on", "mat", "a", "dog", "ran", "fast", "very"]
-    for _ in range(200):
-        hyp = [rng.choice(vocab) for _ in range(rng.randint(1, 12))]
-        ref = [rng.choice(vocab) for _ in range(rng.randint(1, 14))]
-        s_native = meteor_score(hyp, ref, use_native=True)
-        s_python = meteor_score(hyp, ref, use_native=False)
-        assert abs(s_native - s_python) < 1e-9, (hyp, ref, s_native, s_python)
+    vocab = [
+        "the", "cat", "sat", "on", "mat", "a", "dog", "ran", "fast", "very",
+        "running", "runs", "sorted", "sorting", "items", "lists", "list",
+    ]
+    for version in ("1.5", "2005"):
+        for _ in range(200):
+            hyp = [rng.choice(vocab) for _ in range(rng.randint(1, 12))]
+            ref = [rng.choice(vocab) for _ in range(rng.randint(1, 14))]
+            s_native = meteor_score(hyp, ref, use_native=True, version=version)
+            s_python = meteor_score(hyp, ref, use_native=False, version=version)
+            assert abs(s_native - s_python) < 1e-9, (
+                version, hyp, ref, s_native, s_python)
 
 
 def test_meteor_min_chunk_alignment():
@@ -120,7 +169,16 @@ def test_meteor_min_chunk_alignment():
     vs ref 'b a b' has a 1-chunk alignment ('a b' contiguous at ref[1:3])."""
     from csat_tpu.metrics.meteor import _align, meteor_score
 
-    m, chunks = _align(["a", "b"], ["b", "a", "b"])
-    assert (m, chunks) == (2, 1)
-    assert abs(meteor_score(["a", "b"], ["b", "a", "b"], use_native=False) - 
+    a = _align(["a", "b"], ["b", "a", "b"])
+    assert (a.matches, a.chunks) == (2, 1)
+    assert abs(meteor_score(["a", "b"], ["b", "a", "b"], use_native=False) -
                meteor_score(["a", "b"], ["b", "a", "b"], use_native=True)) < 1e-9
+
+
+def test_meteor_exact_preferred_over_stem():
+    """With both an exact and a stem candidate, the exact match must win
+    (higher module weight): hyp 'runs' vs ref 'running runs'."""
+    from csat_tpu.metrics.meteor import _align
+
+    a = _align(["runs"], ["running", "runs"])
+    assert a.matches == 1 and a.pairs == [(0, 1, 1.0)]
