@@ -47,6 +47,10 @@ __all__ = ["Meteor", "meteor_score", "porter_stem", "normalize_tokens"]
 # METEOR-1.5 English task parameters (Denkowski & Lavie 2014, `-l en`).
 ALPHA, BETA, GAMMA, DELTA = 0.85, 0.2, 0.6, 0.75
 W_EXACT, W_STEM = 1.0, 0.6
+# integer module weights (exact=5, stem=3, i.e. ×5) used inside the
+# alignment search so weight ties are exact — float accumulation order
+# would otherwise defeat the min-chunk tiebreak
+WI_EXACT, WI_STEM, WI_SCALE = 5, 3, 5
 
 # Standard English function words (articles, auxiliaries, conjunctions,
 # prepositions, pronouns, punctuation). The jar loads its list from a
@@ -70,6 +74,11 @@ more less few much many own same such only very too also just there here
 # ---------------------------------------------------------------------------
 
 _VOWELS = "aeiou"
+_STEP4 = tuple(sorted(
+    ("al", "ance", "ence", "er", "ic", "able", "ible", "ant", "ement",
+     "ment", "ent", "ion", "ou", "ism", "ate", "iti", "ous", "ive", "ize"),
+    key=len, reverse=True,
+))
 
 
 def _is_cons(word: str, i: int) -> bool:
@@ -119,9 +128,9 @@ def porter_stem(word: str) -> str:
     documented jar delta in the module docstring.
     """
     w = word
-    # ASCII-only, like the C++ mirror — non-ASCII tokens pass through
+    # lowercase-ASCII only, like the C++ mirror — other tokens pass through
     # unstemmed on both paths so the differential invariant holds
-    if len(w) <= 2 or not (w.isascii() and w.isalpha()):
+    if len(w) <= 2 or not (w.isascii() and w.isalpha() and w.islower()):
         return w
 
     # Step 1a
@@ -186,11 +195,7 @@ def porter_stem(word: str) -> str:
             break
 
     # Step 4
-    step4 = (
-        "al", "ance", "ence", "er", "ic", "able", "ible", "ant", "ement",
-        "ment", "ent", "ion", "ou", "ism", "ate", "iti", "ous", "ive", "ize",
-    )
-    for suf in sorted(step4, key=len, reverse=True):
+    for suf in _STEP4:
         if w.endswith(suf):
             stem = w[: -len(suf)]
             if _measure(stem) > 1:
@@ -263,13 +268,12 @@ class _Alignment:
         return self.chunks < other.chunks
 
 
-def _greedy_align(edges: List[List[Tuple[int, float]]], r: int) -> _Alignment:
+def _greedy_align(edges: List[List[Tuple[int, int]]], r: int) -> _Alignment:
     """Iterative adjacent-first greedy pass — the long-input path (the
     branch-and-bound below recurses once per hyp position)."""
     used = [False] * r
     pairs: List[Tuple[int, int, float]] = []
-    chunks, prev = 0, -2
-    weight = 0.0
+    chunks, prev, weight = 0, -2, 0
     for i, cand in enumerate(edges):
         pick = None
         for j, w in sorted(cand, key=lambda e: (e[0] != prev + 1, -e[1], e[0])):
@@ -281,7 +285,7 @@ def _greedy_align(edges: List[List[Tuple[int, float]]], r: int) -> _Alignment:
             continue
         j, w = pick
         used[j] = True
-        pairs.append((i, j, w))
+        pairs.append((i, j, w / WI_SCALE))
         chunks += j != prev + 1
         weight += w
         prev = j
@@ -305,15 +309,15 @@ def _align(
     n, r = len(hyp), len(ref)
     h_stem = [porter_stem(t) for t in hyp] if use_stem else None
     r_stem = [porter_stem(t) for t in ref] if use_stem else None
-    # edge list per hyp position: (ref_pos, module weight)
-    edges: List[List[Tuple[int, float]]] = []
+    # edge list per hyp position: (ref_pos, integer module weight)
+    edges: List[List[Tuple[int, int]]] = []
     for i in range(n):
-        cand: List[Tuple[int, float]] = []
+        cand: List[Tuple[int, int]] = []
         for j in range(r):
             if hyp[i] == ref[j]:
-                cand.append((j, W_EXACT))
+                cand.append((j, WI_EXACT))
             elif use_stem and h_stem[i] == r_stem[j]:
-                cand.append((j, W_STEM))
+                cand.append((j, WI_STEM))
         edges.append(cand)
 
     if n > 256 or r > 256:
@@ -324,28 +328,31 @@ def _align(
     best: List[Optional[_Alignment]] = [None]
     nodes = [0]
     used = [False] * r
-    cur: List[Tuple[int, int, float]] = []
+    cur: List[Tuple[int, int, int]] = []
 
-    def dfs(i: int, matches: int, weight: float, chunks: int, prev: int) -> None:
+    def dfs(i: int, matches: int, weight: int, chunks: int, prev: int) -> None:
         if nodes[0] > node_cap:
             return
         # optimistic bound: every remaining hyp position matches exactly
-        # with no new chunk
+        # with no new chunk (integer weights → exact tie comparisons)
         rem = n - i
         b = best[0]
         if b is not None:
             if matches + rem < b.matches:
                 return
-            if matches + rem == b.matches and weight + rem * W_EXACT < b.weight:
+            if matches + rem == b.matches and weight + rem * WI_EXACT < b.weight:
                 return
             if (
                 matches + rem == b.matches
-                and weight + rem * W_EXACT == b.weight
+                and weight + rem * WI_EXACT == b.weight
                 and chunks >= b.chunks
             ):
                 return
         if i == n:
-            cand = _Alignment(matches, weight, chunks, list(cur))
+            cand = _Alignment(
+                matches, weight, chunks,
+                [(hi, rj, w / WI_SCALE) for hi, rj, w in cur],
+            )
             if b is None or cand.better_than(b):
                 best[0] = cand
             return
@@ -362,7 +369,7 @@ def _align(
             used[j] = False
         dfs(i + 1, matches, weight, chunks, -2)
 
-    dfs(0, 0, 0.0, 0, -2)
+    dfs(0, 0, 0, 0, -2)
     assert best[0] is not None  # the all-skip leaf always completes
     return best[0]
 

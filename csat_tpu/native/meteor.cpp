@@ -36,6 +36,9 @@ namespace {
 
 constexpr double ALPHA = 0.85, BETA = 0.2, GAMMA = 0.6, DELTA = 0.75;
 constexpr double W_EXACT = 1.0, W_STEM = 0.6;
+// integer module weights (x5) inside the alignment search so weight ties
+// are exact — mirrors csat_tpu/metrics/meteor.py WI_EXACT/WI_STEM
+constexpr int WI_EXACT = 5, WI_STEM = 3, WI_SCALE = 5;
 
 std::vector<std::string> tokenize(const char* s) {
     std::vector<std::string> out;
@@ -232,20 +235,20 @@ std::string porter_stem(const std::string& word) {
 
 struct Pair3 {
     int i, j;
-    double w;
+    int w;  // integer module weight (x5); divide by WI_SCALE for scoring
 };
 
 struct Aligner {
     const std::vector<std::string>& hyp;
     const std::vector<std::string>& ref;
-    std::vector<std::vector<std::pair<int, double>>> edges;
+    std::vector<std::vector<std::pair<int, int>>> edges;
     std::vector<char> used;
     std::vector<Pair3> cur;
     long node_cap, nodes = 0;
 
     bool have_best = false;
     int best_matches = 0, best_chunks = 0;
-    double best_weight = 0.0;
+    long best_weight = 0;
     std::vector<Pair3> best_pairs;
 
     Aligner(const std::vector<std::string>& h, const std::vector<std::string>& r,
@@ -260,30 +263,30 @@ struct Aligner {
         for (size_t i = 0; i < h.size(); ++i)
             for (size_t j = 0; j < r.size(); ++j) {
                 if (h[i] == r[j])
-                    edges[i].push_back({(int)j, W_EXACT});
+                    edges[i].push_back({(int)j, WI_EXACT});
                 else if (use_stem && hs[i] == rs[j])
-                    edges[i].push_back({(int)j, W_STEM});
+                    edges[i].push_back({(int)j, WI_STEM});
             }
         used.assign(r.size(), 0);
     }
 
-    bool candidate_better(int m, double w, int ch) const {
+    bool candidate_better(int m, long w, int ch) const {
         if (!have_best) return true;
         if (m != best_matches) return m > best_matches;
         if (w != best_weight) return w > best_weight;
         return ch < best_chunks;
     }
 
-    void dfs(int i, int matches, double weight, int chunks, int prev) {
+    void dfs(int i, int matches, long weight, int chunks, int prev) {
         if (nodes > node_cap) return;
         int rem = (int)hyp.size() - i;
         if (have_best) {
             if (matches + rem < best_matches) return;
             if (matches + rem == best_matches &&
-                weight + rem * W_EXACT < best_weight)
+                weight + rem * WI_EXACT < best_weight)
                 return;
             if (matches + rem == best_matches &&
-                weight + rem * W_EXACT == best_weight && chunks >= best_chunks)
+                weight + rem * WI_EXACT == best_weight && chunks >= best_chunks)
                 return;
         }
         if (i == (int)hyp.size()) {
@@ -297,12 +300,12 @@ struct Aligner {
             return;
         }
         ++nodes;
-        std::vector<std::pair<int, double>> cands;
+        std::vector<std::pair<int, int>> cands;
         for (const auto& e : edges[i])
             if (!used[e.first]) cands.push_back(e);
         std::stable_sort(cands.begin(), cands.end(),
-                         [&](const std::pair<int, double>& a,
-                             const std::pair<int, double>& b) {
+                         [&](const std::pair<int, int>& a,
+                             const std::pair<int, int>& b) {
                              bool aa = a.first != prev + 1, bb = b.first != prev + 1;
                              if (aa != bb) return aa < bb;
                              if (a.second != b.second) return a.second > b.second;
@@ -324,16 +327,16 @@ struct Aligner {
     void run_greedy() {
         std::fill(used.begin(), used.end(), 0);
         best_pairs.clear();
-        best_weight = 0.0;
+        best_weight = 0;
         best_chunks = 0;
         int prev = -2;
         for (int i = 0; i < (int)hyp.size(); ++i) {
-            std::vector<std::pair<int, double>> cands;
+            std::vector<std::pair<int, int>> cands;
             for (const auto& e : edges[i])
                 if (!used[e.first]) cands.push_back(e);
             std::stable_sort(cands.begin(), cands.end(),
-                             [&](const std::pair<int, double>& a,
-                                 const std::pair<int, double>& b) {
+                             [&](const std::pair<int, int>& a,
+                                 const std::pair<int, int>& b) {
                                  bool aa = a.first != prev + 1,
                                       bb = b.first != prev + 1;
                                  if (aa != bb) return aa < bb;
@@ -385,8 +388,9 @@ double meteor_score_c(const char* hyp_s, const char* ref_s, int v15) {
         for (const auto& t : hyp) wl_h += content_weight(t);
         for (const auto& t : ref) wl_r += content_weight(t);
         for (const auto& pr : a.best_pairs) {
-            wm_h += pr.w * content_weight(hyp[pr.i]);
-            wm_r += pr.w * content_weight(ref[pr.j]);
+            double w = (double)pr.w / WI_SCALE;
+            wm_h += w * content_weight(hyp[pr.i]);
+            wm_r += w * content_weight(ref[pr.j]);
         }
         double p = wl_h > 0 ? wm_h / wl_h : 0.0;
         double r = wl_r > 0 ? wm_r / wl_r : 0.0;

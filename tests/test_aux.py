@@ -6,6 +6,7 @@ import os
 import jax
 import jax.numpy as jnp
 import numpy as np
+import pytest
 
 from csat_tpu.metrics.acc import MatchAccMetric, match_accuracy
 from csat_tpu.utils import PAD
@@ -35,6 +36,7 @@ def test_preprocess_ignore_idx(tmp_path):
     assert nls[:3] == ["adds 0", "adds 2", "adds 4"]
 
 
+@pytest.mark.slow
 def test_remat_forward_and_grads_match(tiny_config):
     from csat_tpu.data.toy import random_batch
     from csat_tpu.train.state import make_model

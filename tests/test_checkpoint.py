@@ -2,6 +2,7 @@
 
 import jax
 import numpy as np
+import pytest
 
 from csat_tpu.data.toy import random_batch
 from csat_tpu.train import make_train_step
@@ -18,6 +19,7 @@ def _setup(tiny_config):
     return cfg, model, tx, state, batch
 
 
+@pytest.mark.slow
 def test_full_state_roundtrip_and_resume(tmp_path, tiny_config):
     cfg, model, tx, state, batch = _setup(tiny_config)
     step_fn = make_train_step(model, tx, cfg)

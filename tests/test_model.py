@@ -147,6 +147,7 @@ def test_sparsity_value_range(tiny_config, synthetic_corpus):
     assert pe.shape == (4, cfg.max_src_len, cfg.pe_dim)
 
 
+@pytest.mark.slow
 def test_all_pe_variants_train_step(tiny_config):
     """Every PE variant (pegen/laplacian/sequential/treepos/triplet) must run
     a jitted train step with finite loss (ref encode dispatch,

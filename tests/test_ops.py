@@ -76,6 +76,7 @@ def test_sbm_pallas_under_jit_and_model(inputs):
     assert bool(jnp.all(jnp.isfinite(out)))
 
 
+@pytest.mark.slow
 def test_model_backend_pallas_matches_xla_forward():
     """Full CSATrans forward with backend=pallas == backend=xla (same rngs)."""
     from csat_tpu.configs import get_config

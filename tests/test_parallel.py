@@ -13,12 +13,14 @@ def test_eight_devices_available():
     assert len(jax.devices()) >= 8
 
 
+@pytest.mark.slow
 def test_dryrun_dp_only():
     loss, info = dryrun_train_step(8, model_par=1)
     assert info["mesh"] == {"data": 8, "model": 1}
     assert np.isfinite(loss)
 
 
+@pytest.mark.slow
 def test_dryrun_dp_tp():
     loss, info = dryrun_train_step(8, model_par=2)
     assert info["mesh"] == {"data": 4, "model": 2}
@@ -45,6 +47,7 @@ def test_param_rules_cover_heavy_kernels():
         assert any(re.match(p, probe) for p in covered), probe
 
 
+@pytest.mark.slow
 def test_dp_matches_single_device_loss():
     """Same batch, same init: 1-device loss == 8-device DP loss (same seed)."""
     from csat_tpu.configs import get_config
@@ -89,6 +92,7 @@ def test_dp_matches_single_device_loss():
     assert abs(loss_single - loss_dp) < 1e-4, (loss_single, loss_dp)
 
 
+@pytest.mark.slow
 def test_seq_parallel_matches_unsharded():
     """dp2×sp2×tp2 must produce the same loss as a single-device step on the
     identical config/batch/seed: sequence parallelism is a layout choice,
@@ -122,6 +126,7 @@ def test_multihost_helpers_single_process():
     assert mesh.shape["data"] == 8
 
 
+@pytest.mark.slow
 def test_trainer_fit_runs_under_seq_mesh(synthetic_corpus):
     """The production Trainer path must activate the seq-sharding
     constraints (fit enters jax.sharding.set_mesh)."""

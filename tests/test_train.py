@@ -11,6 +11,24 @@ from csat_tpu.train import Trainer, greedy_decode, greedy_decode_nocache, run_te
 from csat_tpu.train.state import make_model
 
 
+def test_train_smoke(synthetic_corpus, tiny_config):
+    """Fast-tier end-to-end slice: a 2-epoch full-attention fit plus one
+    greedy decode finishes and produces finite numbers."""
+    cfg = tiny_config.replace(
+        data_dir=synthetic_corpus, full_att=True, num_epochs=2,
+        val_interval=2, dropout=0.0, attention_dropout=0.0,
+    )
+    trainer = Trainer(cfg, log=lambda s: None)
+    train_ds = ASTDataset(cfg, "train", trainer.src_vocab, trainer.tgt_vocab)
+    state, history = trainer.fit(train_ds, None)
+    assert np.isfinite(history["loss"][-1])
+    batch = next(iterate_batches(train_ds, 8, shuffle=False))
+    out = np.asarray(
+        greedy_decode(trainer.model, {"params": state.params}, batch, jax.random.key(0))
+    )
+    assert out.shape == (8, cfg.max_tgt_len - 1)
+
+
 @pytest.fixture(scope="module")
 def trained(synthetic_corpus, tiny_config):
     """Train the CPU-smoke config (full attention, ref python_full_att) to
@@ -31,17 +49,20 @@ def trained(synthetic_corpus, tiny_config):
     return cfg, trainer, state, history, train_ds, val_ds
 
 
+@pytest.mark.slow
 def test_loss_decreases(trained):
     _, _, _, history, _, _ = trained
     losses = history["loss"]
     assert losses[-1] < losses[0] * 0.3, losses
 
 
+@pytest.mark.slow
 def test_val_bleu_learns(trained):
     _, _, _, history, _, _ = trained
     assert history["best_bleu"] > 0.35, history["val_bleu"]
 
 
+@pytest.mark.slow
 def test_full_test_metrics(trained, synthetic_corpus):
     cfg, trainer, state, history, _, _ = trained
     test_ds = ASTDataset(cfg, "test", trainer.src_vocab, trainer.tgt_vocab)
@@ -55,6 +76,7 @@ def test_full_test_metrics(trained, synthetic_corpus):
     assert scores["meteor"] > 10.0
 
 
+@pytest.mark.slow
 def test_cached_decode_matches_nocache(trained):
     """KV-cache scan decode must emit exactly the tokens the reference-shaped
     full-prefix re-run emits."""
@@ -67,6 +89,7 @@ def test_cached_decode_matches_nocache(trained):
     np.testing.assert_array_equal(fast, slow)
 
 
+@pytest.mark.slow
 def test_sbm_training_step_runs(synthetic_corpus, tiny_config):
     """One SBM (sparse-attention) train step: finite loss, sparsity in (0,1),
     grads flow to cluster embeddings through the STE."""
