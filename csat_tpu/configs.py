@@ -80,6 +80,15 @@ class Config:
 
     # --- TPU-native axes (no reference equivalent) ---
     backend: str = "xla"  # "xla" | "pallas"
+    # Bernoulli/dropout randomness for the SBM graph:
+    # "shared"  — a jax.random (B,H,N,N) noise tensor threaded through the
+    #             chain (reference-compat; bit-identical across backends);
+    # "counter" — counter-based hash stream (csat_tpu/ops/hashrng.py):
+    #             generated in-kernel on the pallas backend, so no
+    #             (B,H,N,N) tensor ever reaches HBM — the long-AST memory
+    #             lever (the XLA backend materializes the same stream for
+    #             differential testing).
+    noise_mode: str = "shared"
     param_dtype: str = "float32"
     compute_dtype: str = "float32"  # "bfloat16" for MXU-friendly training
     mesh_shape: Tuple[Tuple[str, int], ...] = (("data", 1), ("model", 1))
@@ -112,6 +121,7 @@ class Config:
             "triplet",
         ), self.use_pegen
         assert self.backend in ("xla", "pallas"), self.backend
+        assert self.noise_mode in ("shared", "counter"), self.noise_mode
         if self.backend == "pallas":
             import importlib.util
 
@@ -180,9 +190,9 @@ _reg(_JAVA.replace(name="java_compare_codescribe", data_dir="./processed/compare
 # (sequence/context parallelism); override mesh_shape to enable, e.g.
 # mesh_shape=(("data", -1), ("seq", 2)).
 _reg(_JAVA.replace(name="java_long", task_name="long_ast_512", max_src_len=512,
-                   mesh_shape=(("data", -1),)))
+                   mesh_shape=(("data", -1),), noise_mode="counter", remat=True))
 _reg(_PY.replace(name="python_long", task_name="long_ast_512", max_src_len=512,
-                 mesh_shape=(("data", -1),)))
+                 mesh_shape=(("data", -1),), noise_mode="counter", remat=True))
 
 
 def get_config(name: str, **overrides) -> Config:

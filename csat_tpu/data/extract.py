@@ -34,35 +34,149 @@ from __future__ import annotations
 
 import ast as py_ast
 import json
-import re
+import string as _string
 from typing import Iterable, List, Optional, Sequence, Tuple
 
 __all__ = [
     "split_camelcase",
     "split_identifier_into_parts",
     "python_to_ast_json",
+    "cst_to_ast_json",
     "extract_corpus",
     "have_tree_sitter",
+    "IDENTIFIER_TYPE",
+    "STRING_TYPE",
 ]
 
-_CAMEL = re.compile(r"(?<=[a-z0-9])(?=[A-Z])|(?<=[A-Z])(?=[A-Z][a-z])")
+# Per-language CST leaf-type tables (ref ``java/process_utils.py:4-111`` ==
+# ``py/process_utils.py:4-103``): which leaf types carry identifiers (split
+# into sub-token chains) and which are string-like (no terminal emitted).
+IDENTIFIER_TYPE = {
+    "java": [
+        "identifier", "type_identifier", "scoped_type_identifier",
+        "scoped_identifier", "enum_constant", "variable_declarator",
+        "local_variable_declaration",
+    ],
+    "python": ["identifier", "list_splat_pattern", "type_conversion"],
+    "ruby": [
+        "identifier", "hash_key_symbol", "simple_symbol", "constant",
+        "instance_variable", "global_variable", "class_variable",
+    ],
+    "javascript": [
+        "identifier", "hash_key_symbol", "simple_symbol", "constant",
+        "instance_variable", "global_variable", "class_variable",
+        "property_identifier", "shorthand_property_identifier",
+        "statement_identifier", "shorthand_property_identifier_pattern",
+        "regex_flags",
+    ],
+    "go": [
+        "identifier", "hash_key_symbol", "simple_symbol", "constant",
+        "instance_variable", "global_variable", "class_variable",
+        "property_identifier", "shorthand_property_identifier",
+        "statement_identifier", "shorthand_property_identifier_pattern",
+        "regex_flags", "type_identifier", "field_identifier",
+        "package_identifier", "label_name",
+    ],
+}
+STRING_TYPE = {
+    # python/java carry two additions over the reference tables
+    # (string_content / string_fragment): modern tree-sitter grammars emit
+    # string *content* as its own leaf, which would otherwise leak raw
+    # string text into the graph as an idt terminal; on the reference's
+    # pinned grammars these types never occur, so behavior is unchanged
+    "java": ["string", "comment", "string_literal", "character_literal",
+             "string_fragment"],
+    "python": [
+        "heredoc_content", "string", "comment", "string_literal",
+        "character_literal", "chained_string", "escape_sequence",
+        "string_content",
+    ],
+    "ruby": [
+        "heredoc_content", "string", "comment", "string_literal",
+        "character_literal", "chained_string", "escape_sequence",
+        "string_content", "heredoc_beginning", "heredoc_end",
+    ],
+    "javascript": [
+        "heredoc_content", "string", "comment", "string_literal",
+        "character_literal", "chained_string", "escape_sequence",
+        "string_content", "heredoc_beginning", "heredoc_end", "jsx_text",
+        "regex_pattern", "string_fragment",
+    ],
+    "go": [
+        "heredoc_content", "string", "comment", "string_literal",
+        "character_literal", "chained_string", "escape_sequence",
+        "string_content", "heredoc_beginning", "heredoc_end",
+        "regex_pattern", "\n", "raw_string_literal", "rune_literal",
+    ],
+}
+# numeric leaf types whose literals are dropped (ref process_utils.py:231-240)
+_NUMBER_TYPES = frozenset({
+    "decimal_integer_literal", "decimal_floating_point_literal",
+    "hex_integer_literal", "integer", "float", "int_literal",
+    "imaginary_literal", "float_literal",
+})
+
+
+def _is_number(s: str) -> bool:
+    """ref ``process_utils.py:is_number`` (float() plus unicode numerics)."""
+    try:
+        float(s)
+        return True
+    except ValueError:
+        pass
+    try:
+        import unicodedata
+
+        unicodedata.numeric(s)
+        return True
+    except (TypeError, ValueError):
+        return False
 
 
 def split_camelcase(token: str) -> List[str]:
-    """``camelCaseHTTPWord`` → ``['camel', 'Case', 'HTTP', 'Word']``
-    (ref ``py/process_utils.py:split_camelcase``)."""
-    parts = _CAMEL.split(token)
-    return [p for p in parts if p]
+    """``camelCaseHTTP2Word`` → ``['camel', 'Case', 'HTTP', '2', 'Word']``.
+
+    Behavior-equivalent to the reference splitter
+    (ref ``py/process_utils.py:split_camelcase``): a new word starts at a
+    lower→upper, alpha→digit, or alnum→special boundary; a run of uppers
+    followed by a lower keeps its last upper as the next word's head
+    (``HTTPWord`` → ``HTTP``, ``Word``).
+    """
+    if not token:
+        return []
+    parts: List[str] = []
+    cur = token[0]
+    for ch in token[1:]:
+        prev = cur[-1]
+        new_upper = ch.isupper() and not prev.isupper()
+        new_digit = ch.isdigit() and not prev.isdigit()
+        new_special = (not ch.isalnum()) and prev.isalnum()
+        left_digit = (not ch.isdigit()) and prev.isdigit()
+        left_special = ch.isalnum() and not prev.isalnum()
+        if new_upper or new_digit or new_special:
+            parts.append(cur)
+            cur = ch
+        elif not ch.isupper() and prev.isupper() and len(cur) > 1:
+            # end of an upper run: its last char heads the new word
+            parts.append(cur[:-1])
+            cur = cur[-1] + ch
+        elif left_digit or left_special:
+            parts.append(cur)
+            cur = ch
+        else:
+            cur += ch
+    parts.append(cur)
+    return parts
 
 
 def split_identifier_into_parts(identifier: str) -> List[str]:
-    """snake_case first, then camelCase within each part
-    (ref ``py/process_utils.py:split_identifier_into_parts``)."""
+    """snake_case first, then camelCase within each part, **lowercased**
+    (ref ``py/process_utils.py:106-119``)."""
     out: List[str] = []
     for snake in identifier.split("_"):
         if not snake:
             continue
-        out.extend(split_camelcase(snake))
+        out.extend(s.lower() for s in split_camelcase(snake))
     return out or [identifier]
 
 
@@ -145,6 +259,67 @@ def have_tree_sitter(language: str = "python") -> bool:
         return False
 
 
+def cst_to_ast_json(root, language: str) -> List[dict]:
+    """tree-sitter-shaped CST → node graph with the reference's exact walk
+    semantics (ref ``java/process_utils.py:dfs_graph``, ``:210-216`` and the
+    identical ``py/process_utils.py:196-272``):
+
+    * nodes whose *type* is a **substring** of ``string.punctuation`` are
+      skipped entirely — the reference's ``node.type in string.punctuation``
+      is a substring test, so multi-char operator types that happen to be
+      substrings (``<=``, ``=>``, ``::``) are skipped while others (``==``,
+      ``!=``) are kept and even emit an ``idt`` terminal (the literal-level
+      check has the same quirk). Reproduced deliberately: the type
+      vocabulary must match what the reference pipeline produced;
+    * Java ``ERROR`` nodes are remapped to type ``parameters`` (the
+      tree-sitter-java recovery quirk, ref ``java/process_utils.py:210-216``);
+    * every surviving node becomes a ``nont`` node — keywords included;
+    * leaf handling: string-like types emit no terminal; identifier types
+      emit a lowercased sub-token *chain* under the ``nont`` node; numeric
+      literals and punctuation literals are dropped; anything else emits a
+      single raw ``idt`` terminal.
+
+    ``root`` only needs ``type`` / ``children`` / ``start_point`` /
+    ``end_point`` / ``text`` attributes, so tests can drive this with
+    vendored CST fixtures when no grammar wheel is installed.
+    """
+    ident_types = IDENTIFIER_TYPE.get(language, IDENTIFIER_TYPE["python"])
+    string_types = STRING_TYPE.get(language, STRING_TYPE["python"])
+    builder = _GraphBuilder()
+
+    def walk(node, parent: Optional[int]) -> None:
+        kind = node.type
+        if kind in _string.punctuation:
+            return
+        if language == "java" and kind == "ERROR":
+            kind = "parameters"
+        start, end = node.start_point[0], node.end_point[0]
+        me = builder.add("nont", kind, start, end)
+        if parent is not None:
+            builder.link(parent, me)
+        if not node.children:
+            if node.type not in string_types:
+                literal = (
+                    node.text.decode(errors="replace")
+                    if isinstance(node.text, bytes)
+                    else str(node.text)
+                )
+                if node.type in ident_types:
+                    builder.add_identifier_chain(me, literal, start, end)
+                elif _is_number(literal) or node.type in _NUMBER_TYPES:
+                    pass
+                elif literal in _string.punctuation:
+                    pass
+                elif literal:
+                    node_id = builder.add("idt", literal, start, end)
+                    builder.link(me, node_id)
+        for child in node.children:
+            walk(child, me)
+
+    walk(root, None)
+    return builder.to_json()
+
+
 def _treesitter_to_ast_json(source: str, language: str) -> List[dict]:  # pragma: no cover
     """tree-sitter CST → node graph, for environments with grammars installed."""
     import tree_sitter
@@ -152,27 +327,7 @@ def _treesitter_to_ast_json(source: str, language: str) -> List[dict]:  # pragma
     lang_mod = __import__(f"tree_sitter_{language}")
     parser = tree_sitter.Parser(tree_sitter.Language(lang_mod.language()))
     tree = parser.parse(source.encode())
-    builder = _GraphBuilder()
-
-    def walk(ts_node, parent):
-        if not ts_node.is_named:
-            return  # punctuation
-        kind = ts_node.type
-        start, end = ts_node.start_point[0] + 1, ts_node.end_point[0] + 1
-        if kind in ("string", "integer", "float", "number_literal", "string_literal"):
-            return  # literals skipped (ref process_utils.py:209-255)
-        if kind == "identifier" or kind.endswith("identifier"):
-            text = ts_node.text.decode(errors="replace")
-            builder.add_identifier_chain(parent, text, start, end)
-            return
-        me = builder.add("nont", kind, start, end)
-        if parent is not None:
-            builder.link(parent, me)
-        for child in ts_node.children:
-            walk(child, me)
-
-    walk(tree.root_node, None)
-    return builder.to_json()
+    return cst_to_ast_json(tree.root_node, language)
 
 
 def source_to_ast_json(source: str, language: str = "python") -> List[dict]:

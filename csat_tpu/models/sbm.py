@@ -75,6 +75,7 @@ class SBMAttention(nn.Module):
     num_clusters: int
     attention_dropout: float
     backend: str = "xla"
+    noise_mode: str = "shared"  # "shared" | "counter" (see configs.Config)
 
     @nn.compact
     def __call__(
@@ -99,27 +100,50 @@ class SBMAttention(nn.Module):
         proj = ClusterProj(dh)
         q_hat = jax.nn.sigmoid(jnp.einsum("bhnd,hkd->bhnk", proj(q, deterministic), clusters))
         k_hat = jax.nn.sigmoid(jnp.einsum("bhnd,hkd->bhnk", proj(k, deterministic), clusters))
-        noise = bernoulli_noise(self.make_rng("sample"), (b, h, n, n))
 
         use_dropout = (not deterministic) and self.attention_dropout > 0.0
+        rate = self.attention_dropout if use_dropout else 0.0
+
+        def draw_seed(name: str):
+            return jax.random.randint(
+                self.make_rng(name), (), 0, jnp.iinfo(jnp.int32).max,
+                dtype=jnp.int32,
+            )
+
+        def head_sparsity(graph_sums):  # ΣA per (batch, head) → per-head
+            return jnp.sum(graph_sums, axis=0) / (b * n * n)
+
+        if self.noise_mode == "counter":
+            # counter-based hash stream (csat_tpu/ops/hashrng.py): the pallas
+            # path generates it in-kernel tile-by-tile — no (B,H,N,N) noise
+            # tensor in HBM; the XLA path materializes the identical field so
+            # the two backends sample the identical graph
+            from csat_tpu.ops.sbm_flash_pallas import TILE, _round_up
+
+            sample_seed = draw_seed("sample")
+            if self.backend == "pallas" and not need_aux:
+                from csat_tpu.ops.sbm_flash_pallas import sbm_attention_flash
+
+                out, graph_sums = sbm_attention_flash(
+                    q, k, v, q_hat, k_hat, s, key_pad, sample_seed,
+                    rate, draw_seed("dropout") if use_dropout else None,
+                )
+                return out, head_sparsity(graph_sums), None, None
+            from csat_tpu.ops.hashrng import uniform_field
+
+            noise = uniform_field(sample_seed, b, h, n, n, _round_up(n, TILE))
+        else:
+            noise = bernoulli_noise(self.make_rng("sample"), (b, h, n, n))
         if self.backend == "pallas" and not need_aux:
             # fully-fused path: expA, the sampled graph, the scores and the
             # attention map never reach HBM (csat_tpu/ops/sbm_fused_pallas.py)
             from csat_tpu.ops.sbm_fused_pallas import sbm_attention_fused_pallas
 
-            seed = (
-                jax.random.randint(
-                    self.make_rng("dropout"), (), 0, jnp.iinfo(jnp.int32).max, dtype=jnp.int32
-                )
-                if use_dropout
-                else None
-            )
             out, graph_sums, _ = sbm_attention_fused_pallas(
                 q, k, v, q_hat, k_hat, s, noise, key_pad,
-                self.attention_dropout if use_dropout else 0.0, seed,
+                rate, draw_seed("dropout") if use_dropout else None,
             )
-            sparsity = jnp.sum(graph_sums, axis=0) / (b * n * n)  # (H,)
-            return out, sparsity, None, None
+            return out, head_sparsity(graph_sums), None, None
 
         exp_a = jnp.einsum("bhnk,hkj,bhmj->bhnm", q_hat, s, k_hat)
         graph = sample_graph(exp_a, noise)
@@ -128,11 +152,8 @@ class SBMAttention(nn.Module):
             from csat_tpu.ops.sbm_pallas import sbm_attention_pallas
 
             if use_dropout:
-                seed = jax.random.randint(
-                    self.make_rng("dropout"), (), 0, jnp.iinfo(jnp.int32).max, dtype=jnp.int32
-                )
                 out, attn = sbm_attention_pallas(
-                    q, k, v, graph, key_pad, self.attention_dropout, seed
+                    q, k, v, graph, key_pad, rate, draw_seed("dropout")
                 )
             else:
                 out, attn = sbm_attention_pallas(q, k, v, graph, key_pad)
@@ -192,6 +213,7 @@ class SBMBlock(nn.Module):
                 cfg.clusters[self.layer_idx],
                 cfg.attention_dropout,
                 backend=cfg.backend,
+                noise_mode=cfg.noise_mode,
             )(q, k, v, key_pad, deterministic, need_aux)
         attn_out = dense(d, self.dtype, name="wo")(merge_heads(attn_out).astype(self.dtype))
         x = x + nn.Dropout(cfg.dropout)(attn_out, deterministic=deterministic)

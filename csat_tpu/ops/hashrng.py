@@ -1,0 +1,67 @@
+"""Counter-based uniform RNG shared by the Pallas kernels and their XLA mirror.
+
+A murmur3-finalizer hash over ``(seed, batch·head, global row, global col)``
+produces the uniform draw for every (i, j) attention pair. Because the
+stream is a pure function of indices it can be
+
+* generated **in-kernel per tile** — no ``(B, H, N, N)`` noise or dropout
+  tensor ever exists in HBM (the round-2 advisor measured the old noise
+  residual at ~537 MB/layer at B=64, N=512);
+* **regenerated in the backward pass** bit-identically;
+* **materialized in plain XLA** (:func:`uniform_field`) so the XLA backend
+  can produce the exact same sampled graph for differential tests.
+
+``pltpu.prng_*`` is deliberately not used: it returns zeros under the CPU
+interpreter, which would break the off-TPU test suite (see
+``csat_tpu/ops/sbm_pallas.py`` for the same decision for dropout).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["hash_bits", "bits_to_uniform", "uniform_field"]
+
+_C1 = 0x9E3779B9  # golden-ratio mix for the seed
+_C2 = 0x85EBCA6B  # murmur3 constant, mixes batch·head
+_C3 = 0xC2B2AE35
+
+
+def hash_bits(
+    seed: jnp.ndarray,  # int32/uint32 scalar
+    bh: jnp.ndarray,  # flattened batch·head index (scalar or array)
+    rows: jnp.ndarray,  # global row index, broadcastable with cols
+    cols: jnp.ndarray,  # global col index
+    stride: int,  # row stride ≥ padded N (rows·stride+cols unique)
+) -> jnp.ndarray:
+    """uint32 hash, identical math on TPU (Mosaic) and CPU (interpret/XLA)."""
+    x = rows.astype(jnp.uint32) * jnp.uint32(stride) + cols.astype(jnp.uint32)
+    x = x ^ (seed.astype(jnp.uint32) * jnp.uint32(_C1))
+    x = x ^ (jnp.asarray(bh).astype(jnp.uint32) * jnp.uint32(_C2))
+    x = x ^ (x >> 16)
+    x = x * jnp.uint32(_C2)
+    x = x ^ (x >> 13)
+    x = x * jnp.uint32(_C3)
+    x = x ^ (x >> 16)
+    return x
+
+
+def bits_to_uniform(bits: jnp.ndarray) -> jnp.ndarray:
+    """uint32 → float32 uniform in [0, 1). The two paths must compare the
+    same float against the same threshold, so the conversion is fixed here:
+    the top 24 bits scaled by 2⁻²⁴ (exactly representable in f32)."""
+    return (bits >> jnp.uint32(8)).astype(jnp.float32) * jnp.float32(1.0 / (1 << 24))
+
+
+def uniform_field(
+    seed: jnp.ndarray, b: int, h: int, n_rows: int, n_cols: int, stride: int
+) -> jnp.ndarray:
+    """XLA mirror: materialize the full (B, H, n_rows, n_cols) uniform field
+    the kernels generate tile-by-tile. Test/compat path only — this is
+    exactly the HBM tensor the kernels exist to avoid."""
+    bh = jax.lax.broadcasted_iota(jnp.uint32, (b, h, 1, 1), 0) * jnp.uint32(h) + \
+        jax.lax.broadcasted_iota(jnp.uint32, (b, h, 1, 1), 1)
+    rows = jax.lax.broadcasted_iota(jnp.uint32, (1, 1, n_rows, n_cols), 2)
+    cols = jax.lax.broadcasted_iota(jnp.uint32, (1, 1, n_rows, n_cols), 3)
+    return bits_to_uniform(hash_bits(seed, bh, rows, cols, stride))

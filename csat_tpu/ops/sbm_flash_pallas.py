@@ -1,0 +1,490 @@
+"""Tiled flash-style SBM attention with in-kernel Bernoulli sampling.
+
+The third-generation SBM kernel (after ``sbm_pallas`` / ``sbm_fused_pallas``,
+which hold whole (N, N) blocks in VMEM per (batch, head) program): the node
+axis is tiled 128×128, so the kernel is lane-aligned for Mosaic, scales to
+the long-AST N=512 configs inside VMEM, and never materializes **any**
+(B, H, N, N) tensor in HBM — not the scores, not the attention map, not the
+sampled graph, and (new) not the Bernoulli noise or the dropout mask, both
+of which are generated in-kernel from the counter-based hash stream in
+:mod:`csat_tpu.ops.hashrng` and regenerated bit-identically in backward.
+
+Chain (ref ``/root/reference/module/sbm_attn.py:38-64`` + ``STE.py``):
+
+    expA  = Q̂ S K̂ᵀ                      (computed per tile as (Q̂S) K̂ᵀ)
+    A     = 1{u < clamp(expA, .01, .99)}  (u from the hash stream)
+    attn  = (softmax(QKᵀ/√d + padmask) ⊙ A) / ‖·‖₁
+    out   = dropout(attn) · V,   spars = Σ A
+
+**Softmax-cancellation.** Because the reference L1-renormalizes after
+masking, the softmax normalizer cancels: ``attn_ij = Aᵉ_ij e^{s_ij} /
+Σ_k Aᵉ_ik e^{s_ik}`` where ``Aᵉ = A ⊙ ¬pad``. The kernel therefore runs
+flash-style streaming statistics (m, l) over **live entries only** and skips
+the score/value matmuls of (q-tile, k-tile) pairs whose sampled block is
+entirely dead — the SURVEY §7.3(3) block-sparsity bet. Honest analysis of
+when tiles die: the reference clamps expA at 0.01, so an unstructured
+128×128 tile is all-zero with probability 0.99^16384 ≈ e⁻¹⁶⁴ — under
+reference-exact sampling the skip fires mainly for structurally dead tiles
+(fully-padded key tiles of ragged batches / the N-padding region), and the
+win over the dense kernels comes from tiling + HBM traffic. With the clamp
+floor lifted (``floor=0.0``, a flagged quirk-fix per SURVEY §8 policy),
+cluster-structured memberships make whole off-cluster tiles die and the
+skip becomes data-dependent.
+
+Semantics delta vs the XLA/torch path (documented, test-tolerated): rows
+whose total masked softmax mass is below the 1e-12 L1-renorm guard are
+emitted by the reference as near-zero unnormalized rows; the streaming
+formulation emits the correctly normalized row (the guard cannot trigger:
+``l ≥ 1`` whenever a live entry exists, since the running max is attained).
+Everywhere else the two are the same function evaluated in a different
+order.
+
+Gradients implement the straight-through estimator exactly
+(``d_expA = clip(A ⊙ d_A, -1, 1)``, ref ``STE.py:17-19``): only sampled-on
+entries propagate to the cluster factors, so the heavy d-chain also skips
+dead tiles; the sparsity-regularizer cotangent (uniform over A's support)
+flows through the cheap cluster matmuls for every tile.
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.dtypes import float0
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from csat_tpu.ops.hashrng import bits_to_uniform, hash_bits
+from csat_tpu.ops.sbm_pallas import _interpret
+
+TILE = 128  # q/k tile edge — MXU/lane aligned
+KPAD = 128  # cluster axis padded to one lane tile
+BIG = 1e30
+
+
+def _round_up(n: int, m: int) -> int:
+    return (n + m - 1) // m * m
+
+
+def _tile_uniform(seed, bh, iq, ik, stride):
+    rows = iq * TILE + jax.lax.broadcasted_iota(jnp.uint32, (TILE, TILE), 0)
+    cols = ik * TILE + jax.lax.broadcasted_iota(jnp.uint32, (TILE, TILE), 1)
+    return rows, cols, bits_to_uniform(hash_bits(seed, bh, rows, cols, stride))
+
+
+def _tile_graph(sseed, bh, iq, ik, r_blk, kh_blk, pad_row, n_real, stride, floor):
+    """Sampled graph for one (q-tile, k-tile): returns (a_raw, a_eff).
+
+    ``a_raw`` matches the XLA-mirror noise field on the real N×N region
+    (sparsity + STE support); ``a_eff`` additionally zeroes padded keys (the
+    entries that can carry attention mass).
+    """
+    rows, cols, u = _tile_uniform(sseed, bh, iq, ik, stride)
+    exp_a = jnp.dot(r_blk, kh_blk.T, preferred_element_type=jnp.float32)
+    p = jnp.clip(exp_a, floor, 0.99)
+    real = (rows < n_real) & (cols < n_real)
+    a_raw = jnp.where((u < p) & real, 1.0, 0.0)
+    a_eff = a_raw * (1.0 - pad_row)
+    return a_raw, a_eff
+
+
+def _keep_scale(dseed, bh, iq, ik, stride, rate):
+    """Dropout keep/(1-rate) field from the hash stream (1.0 when rate=0)."""
+    _, _, u = _tile_uniform(dseed, bh, iq, ik, stride)
+    return jnp.where(u >= rate, 1.0 / (1.0 - rate), 0.0)
+
+
+# ---------------------------------------------------------------------------
+# forward
+# ---------------------------------------------------------------------------
+
+def _fwd_kernel(
+    sseed_ref, dseed_ref, q_ref, k_ref, v_ref, r_ref, kh_ref, pad_ref,
+    out_ref, spars_ref, lse_ref, m_scr, l_scr, acc_scr,
+    *, rate: float, n_real: int, stride: int, n_heads: int, floor: float,
+):
+    b, h, iq, ik = (pl.program_id(i) for i in range(4))
+    nk = pl.num_programs(3)
+    bh = b * n_heads + h
+
+    @pl.when((iq == 0) & (ik == 0))
+    def _():
+        spars_ref[0, 0] = 0.0
+
+    @pl.when(ik == 0)
+    def _():
+        m_scr[...] = jnp.full_like(m_scr[...], -BIG)
+        l_scr[...] = jnp.zeros_like(l_scr[...])
+        acc_scr[...] = jnp.zeros_like(acc_scr[...])
+
+    pad_row = pad_ref[0][None, :]  # (1, TILE) — this k-tile's key padding
+    a_raw, a_eff = _tile_graph(
+        sseed_ref[0], bh, iq, ik, r_ref[0, 0], kh_ref[0, 0], pad_row,
+        n_real, stride, floor,
+    )
+    spars_ref[0, 0] += jnp.sum(a_raw)
+
+    @pl.when(jnp.sum(a_eff) > 0)
+    def _():
+        q, k, v = q_ref[0, 0], k_ref[0, 0], v_ref[0, 0]
+        s = jnp.dot(q, k.T, preferred_element_type=jnp.float32)
+        s = s * (1.0 / math.sqrt(q.shape[-1]))
+        s = jnp.where(a_eff > 0, s, -BIG)
+        m_prev = m_scr[...]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
+        alpha = jnp.exp(m_prev - m_new)
+        w = jnp.exp(s - m_new) * a_eff
+        l_scr[...] = l_scr[...] * alpha + jnp.sum(w, axis=-1, keepdims=True)
+        if rate > 0.0:
+            w = w * _keep_scale(dseed_ref[0], bh, iq, ik, stride, rate)
+        acc_scr[...] = acc_scr[...] * alpha + jnp.dot(
+            w, v, preferred_element_type=jnp.float32
+        )
+        m_scr[...] = m_new
+
+    @pl.when(ik == nk - 1)
+    def _():
+        l = l_scr[...]
+        live = l > 0.0
+        out_ref[0, 0] = jnp.where(live, acc_scr[...] / jnp.maximum(l, 1e-30), 0.0)
+        lse = jnp.where(live, m_scr[...] + jnp.log(jnp.maximum(l, 1e-30)), -BIG)
+        lse_ref[0, 0] = lse[:, 0]
+
+
+# ---------------------------------------------------------------------------
+# backward (two passes: q-side accumulation, then k-side accumulation)
+# ---------------------------------------------------------------------------
+
+def _bwd_tile(
+    live, a_raw, a_eff, q, k, v, g_out, lse, dvec, pad_row, gs, keep, inv_sqrt
+):
+    """Shared per-tile backward math. Returns (d_expA, d_s, attn_d)."""
+
+    def heavy(_):
+        s = jnp.dot(q, k.T, preferred_element_type=jnp.float32) * inv_sqrt
+        lse_col = lse[:, None]
+        finite = lse_col > -BIG / 2
+        e = jnp.where(finite, jnp.exp(s - jnp.where(finite, lse_col, 0.0)), 0.0)
+        attn = e * a_eff
+        d_attn = jnp.dot(g_out, v.T, preferred_element_type=jnp.float32) * keep
+        d_s = attn * (d_attn - dvec[:, None])
+        d_a = e * (d_attn - dvec[:, None]) * (1.0 - pad_row) + gs
+        d_exp_a = jnp.clip(a_raw * d_a, -1.0, 1.0)
+        return d_exp_a, d_s, attn * keep
+
+    def cheap(_):
+        z = jnp.zeros((TILE, TILE), jnp.float32)
+        return jnp.clip(a_raw * gs, -1.0, 1.0), z, z
+
+    return jax.lax.cond(live, heavy, cheap, None)
+
+
+def _bwd_q_kernel(
+    sseed_ref, dseed_ref, q_ref, k_ref, v_ref, r_ref, kh_ref, pad_ref,
+    lse_ref, dvec_ref, go_ref, gs_ref,
+    dq_ref, dr_ref, dq_scr, dr_scr,
+    *, rate: float, n_real: int, stride: int, n_heads: int, floor: float,
+):
+    b, h, iq, ik = (pl.program_id(i) for i in range(4))
+    nk = pl.num_programs(3)
+    bh = b * n_heads + h
+
+    @pl.when(ik == 0)
+    def _():
+        dq_scr[...] = jnp.zeros_like(dq_scr[...])
+        dr_scr[...] = jnp.zeros_like(dr_scr[...])
+
+    pad_row = pad_ref[0][None, :]
+    a_raw, a_eff = _tile_graph(
+        sseed_ref[0], bh, iq, ik, r_ref[0, 0], kh_ref[0, 0], pad_row,
+        n_real, stride, floor,
+    )
+    q, k, v = q_ref[0, 0], k_ref[0, 0], v_ref[0, 0]
+    inv = 1.0 / math.sqrt(q.shape[-1])
+    keep = (
+        _keep_scale(dseed_ref[0], bh, iq, ik, stride, rate) if rate > 0.0 else 1.0
+    )
+    live = jnp.sum(a_eff) > 0
+    d_exp_a, d_s, _ = _bwd_tile(
+        live, a_raw, a_eff, q, k, v, go_ref[0, 0], lse_ref[0, 0],
+        dvec_ref[0, 0], pad_row, gs_ref[0, 0], keep, inv,
+    )
+
+    @pl.when(live)
+    def _():
+        dq_scr[...] += jnp.dot(d_s, k, preferred_element_type=jnp.float32) * inv
+
+    dr_scr[...] += jnp.dot(d_exp_a, kh_ref[0, 0], preferred_element_type=jnp.float32)
+
+    @pl.when(ik == nk - 1)
+    def _():
+        dq_ref[0, 0] = dq_scr[...]
+        dr_ref[0, 0] = dr_scr[...]
+
+
+def _bwd_k_kernel(
+    sseed_ref, dseed_ref, q_ref, k_ref, v_ref, r_ref, kh_ref, pad_ref,
+    lse_ref, dvec_ref, go_ref, gs_ref,
+    dk_ref, dv_ref, dkh_ref, dk_scr, dv_scr, dkh_scr,
+    *, rate: float, n_real: int, stride: int, n_heads: int, floor: float,
+):
+    b, h, ik, iq = (pl.program_id(i) for i in range(4))
+    nq = pl.num_programs(3)
+    bh = b * n_heads + h
+
+    @pl.when(iq == 0)
+    def _():
+        dk_scr[...] = jnp.zeros_like(dk_scr[...])
+        dv_scr[...] = jnp.zeros_like(dv_scr[...])
+        dkh_scr[...] = jnp.zeros_like(dkh_scr[...])
+
+    pad_row = pad_ref[0][None, :]
+    a_raw, a_eff = _tile_graph(
+        sseed_ref[0], bh, iq, ik, r_ref[0, 0], kh_ref[0, 0], pad_row,
+        n_real, stride, floor,
+    )
+    q, k, v = q_ref[0, 0], k_ref[0, 0], v_ref[0, 0]
+    inv = 1.0 / math.sqrt(q.shape[-1])
+    keep = (
+        _keep_scale(dseed_ref[0], bh, iq, ik, stride, rate) if rate > 0.0 else 1.0
+    )
+    live = jnp.sum(a_eff) > 0
+    d_exp_a, d_s, attn_d = _bwd_tile(
+        live, a_raw, a_eff, q, k, v, go_ref[0, 0], lse_ref[0, 0],
+        dvec_ref[0, 0], pad_row, gs_ref[0, 0], keep, inv,
+    )
+
+    @pl.when(live)
+    def _():
+        dk_scr[...] += jnp.dot(d_s.T, q, preferred_element_type=jnp.float32) * inv
+        dv_scr[...] += jnp.dot(
+            attn_d.T, go_ref[0, 0], preferred_element_type=jnp.float32
+        )
+
+    dkh_scr[...] += jnp.dot(
+        d_exp_a.T, r_ref[0, 0], preferred_element_type=jnp.float32
+    )
+
+    @pl.when(iq == nq - 1)
+    def _():
+        dk_ref[0, 0] = dk_scr[...]
+        dv_ref[0, 0] = dv_scr[...]
+        dkh_ref[0, 0] = dkh_scr[...]
+
+
+# ---------------------------------------------------------------------------
+# pallas_call plumbing
+# ---------------------------------------------------------------------------
+
+def _pad_nodes(x, n_pad):
+    return jnp.pad(x, [(0, 0)] * (x.ndim - 2) + [(0, n_pad - x.shape[-2]), (0, 0)])
+
+
+def _specs(dh):
+    smem = pl.BlockSpec(memory_space=pltpu.SMEM)
+    qspec = lambda g: pl.BlockSpec(
+        (1, 1, TILE, dh), lambda b, h, i, j: (b, h, g(i, j), 0), memory_space=pltpu.VMEM)
+    cspec = lambda g: pl.BlockSpec(
+        (1, 1, TILE, KPAD), lambda b, h, i, j: (b, h, g(i, j), 0), memory_space=pltpu.VMEM)
+    vec = lambda g: pl.BlockSpec(
+        (1, 1, TILE), lambda b, h, i, j: (b, h, g(i, j)), memory_space=pltpu.VMEM)
+    pad = lambda g: pl.BlockSpec(
+        (1, TILE), lambda b, h, i, j: (b, g(i, j)), memory_space=pltpu.VMEM)
+    scal = pl.BlockSpec((1, 1), lambda b, h, i, j: (b, h), memory_space=pltpu.VMEM)
+    return smem, qspec, cspec, vec, pad, scal
+
+
+def _cost(b, h, nq, nk, dh, fwd=True):
+    n2 = nq * nk * TILE * TILE
+    mul = 4 if fwd else 10
+    return pl.CostEstimate(
+        flops=b * h * n2 * (mul * dh + 2 * KPAD + 10),
+        bytes_accessed=b * h * (nq + nk) * TILE * (2 * dh + KPAD) * 4,
+        transcendentals=b * h * n2,
+    )
+
+
+def _fwd_call(q, k, v, r, kh, pad, sseed, dseed, rate, n_real, floor):
+    b, h, n_pad, dh = q.shape
+    nq = nk = n_pad // TILE
+    smem, qspec, cspec, vec, padspec, scal = _specs(dh)
+    kernel = functools.partial(
+        _fwd_kernel, rate=float(rate), n_real=n_real, stride=n_pad,
+        n_heads=h, floor=float(floor),
+    )
+    out, spars, lse = pl.pallas_call(
+        kernel,
+        grid=(b, h, nq, nk),
+        in_specs=[
+            smem, smem,
+            qspec(lambda i, j: i), qspec(lambda i, j: j), qspec(lambda i, j: j),
+            cspec(lambda i, j: i), cspec(lambda i, j: j),
+            padspec(lambda i, j: j),
+        ],
+        out_specs=[qspec(lambda i, j: i), scal, vec(lambda i, j: i)],
+        out_shape=[
+            jax.ShapeDtypeStruct((b, h, n_pad, dh), jnp.float32),
+            jax.ShapeDtypeStruct((b, h), jnp.float32),
+            jax.ShapeDtypeStruct((b, h, n_pad), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((TILE, 1), jnp.float32),
+            pltpu.VMEM((TILE, 1), jnp.float32),
+            pltpu.VMEM((TILE, dh), jnp.float32),
+        ],
+        cost_estimate=_cost(b, h, nq, nk, dh, fwd=True),
+        interpret=_interpret(),
+    )(sseed, dseed, q, k, v, r, kh, pad)
+    return out, spars, lse
+
+
+def _bwd_call(q, k, v, r, kh, pad, lse, dvec, g_out, gs, sseed, dseed, rate,
+              n_real, floor):
+    b, h, n_pad, dh = q.shape
+    nq = nk = n_pad // TILE
+    smem, qspec, cspec, vec, padspec, scal = _specs(dh)
+    common = dict(rate=float(rate), n_real=n_real, stride=n_pad, n_heads=h,
+                  floor=float(floor))
+    in_q = [
+        smem, smem,
+        qspec(lambda i, j: i), qspec(lambda i, j: j), qspec(lambda i, j: j),
+        cspec(lambda i, j: i), cspec(lambda i, j: j), padspec(lambda i, j: j),
+        vec(lambda i, j: i), vec(lambda i, j: i), qspec(lambda i, j: i), scal,
+    ]
+    dq, dr = pl.pallas_call(
+        functools.partial(_bwd_q_kernel, **common),
+        grid=(b, h, nq, nk),
+        in_specs=in_q,
+        out_specs=[qspec(lambda i, j: i), cspec(lambda i, j: i)],
+        out_shape=[
+            jax.ShapeDtypeStruct((b, h, n_pad, dh), jnp.float32),
+            jax.ShapeDtypeStruct((b, h, n_pad, KPAD), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((TILE, dh), jnp.float32),
+            pltpu.VMEM((TILE, KPAD), jnp.float32),
+        ],
+        cost_estimate=_cost(b, h, nq, nk, dh, fwd=False),
+        interpret=_interpret(),
+    )(sseed, dseed, q, k, v, r, kh, pad, lse, dvec, g_out, gs)
+
+    # k-side pass: grid dim 2 is the k tile, dim 3 sweeps q tiles
+    in_k = [
+        smem, smem,
+        qspec(lambda i, j: j), qspec(lambda i, j: i), qspec(lambda i, j: i),
+        cspec(lambda i, j: j), cspec(lambda i, j: i), padspec(lambda i, j: i),
+        vec(lambda i, j: j), vec(lambda i, j: j), qspec(lambda i, j: j), scal,
+    ]
+    dk, dv, dkh = pl.pallas_call(
+        functools.partial(_bwd_k_kernel, **common),
+        grid=(b, h, nk, nq),
+        in_specs=in_k,
+        out_specs=[
+            qspec(lambda i, j: i), qspec(lambda i, j: i), cspec(lambda i, j: i),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((b, h, n_pad, dh), jnp.float32),
+            jax.ShapeDtypeStruct((b, h, n_pad, dh), jnp.float32),
+            jax.ShapeDtypeStruct((b, h, n_pad, KPAD), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((TILE, dh), jnp.float32),
+            pltpu.VMEM((TILE, dh), jnp.float32),
+            pltpu.VMEM((TILE, KPAD), jnp.float32),
+        ],
+        cost_estimate=_cost(b, h, nq, nk, dh, fwd=False),
+        interpret=_interpret(),
+    )(sseed, dseed, q, k, v, r, kh, pad, lse, dvec, g_out, gs)
+    return dq, dr, dk, dv, dkh
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(8, 9))
+def _flash(q, k, v, q_hat, k_hat, s_aff, pad, seeds, rate, floor):
+    out, spars, _ = _flash_fwd_parts(q, k, v, q_hat, k_hat, s_aff, pad, seeds,
+                                     rate, floor)
+    return out, spars
+
+
+def _flash_fwd_parts(q, k, v, q_hat, k_hat, s_aff, pad, seeds, rate, floor):
+    b, h, n, dh = q.shape
+    kk = q_hat.shape[-1]
+    n_pad = _round_up(n, TILE)
+    r = jnp.einsum("bhnk,hkj->bhnj", q_hat, s_aff)
+    qp, kp, vp = (_pad_nodes(x, n_pad) for x in (q, k, v))
+    rp = jnp.pad(r, ((0, 0), (0, 0), (0, n_pad - n), (0, KPAD - kk)))
+    khp = jnp.pad(k_hat, ((0, 0), (0, 0), (0, n_pad - n), (0, KPAD - kk)))
+    padp = jnp.pad(pad.astype(jnp.float32), ((0, 0), (0, n_pad - n)),
+                   constant_values=1.0)
+    sseed = seeds[:1]
+    dseed = seeds[1:]
+    out_p, spars, lse = _fwd_call(qp, kp, vp, rp, khp, padp, sseed, dseed,
+                                  rate, n, floor)
+    return out_p[:, :, :n, :], spars, (out_p, lse, qp, kp, vp, rp, khp, padp)
+
+
+def _flash_vjp_fwd(q, k, v, q_hat, k_hat, s_aff, pad, seeds, rate, floor):
+    out, spars, extras = _flash_fwd_parts(
+        q, k, v, q_hat, k_hat, s_aff, pad, seeds, rate, floor)
+    out_p, lse, qp, kp, vp, rp, khp, padp = extras
+    res = (q_hat, s_aff, out_p, lse, qp, kp, vp, rp, khp, padp, seeds, pad)
+    return (out, spars), res
+
+
+def _flash_vjp_bwd(rate, floor, res, cots):
+    g_out, g_spars = cots
+    q_hat, s_aff, out_p, lse, qp, kp, vp, rp, khp, padp, seeds, pad = res
+    b, h, n_pad, dh = qp.shape
+    n = g_out.shape[2]
+    kk = q_hat.shape[-1]
+    go_p = _pad_nodes(g_out, n_pad)
+    dvec = jnp.sum(go_p * out_p, axis=-1)  # (B, H, n_pad)
+    gs = g_spars.astype(jnp.float32)  # (B, H) — sparsity-sum cotangent
+    dq, dr, dk, dv, dkh = _bwd_call(
+        qp, kp, vp, rp, khp, padp, lse, dvec, go_p, gs,
+        seeds[:1], seeds[1:], rate, n, floor,
+    )
+    dr = dr[:, :, :n, :kk]
+    d_q_hat = jnp.einsum("bhnj,hkj->bhnk", dr, s_aff)
+    d_s_aff = jnp.einsum("bhnk,bhnj->hkj", q_hat, dr)
+    return (
+        dq[:, :, :n, :], dk[:, :, :n, :], dv[:, :, :n, :],
+        d_q_hat, dkh[:, :, :n, :kk], d_s_aff,
+        jnp.zeros_like(pad, dtype=jnp.float32),
+        np.zeros(seeds.shape, dtype=float0),
+    )
+
+
+_flash.defvjp(_flash_vjp_fwd, _flash_vjp_bwd)
+
+
+def sbm_attention_flash(
+    q: jnp.ndarray,       # (B, H, N, dh) fp32
+    k: jnp.ndarray,
+    v: jnp.ndarray,
+    q_hat: jnp.ndarray,   # (B, H, N, K) fp32 — soft cluster memberships
+    k_hat: jnp.ndarray,
+    s_aff: jnp.ndarray,   # (H, K, K) fp32 — cluster affinity
+    key_pad: jnp.ndarray,  # (B, N), truthy = padded
+    sample_seed: jnp.ndarray,  # int32 scalar — Bernoulli hash stream
+    dropout_rate: float = 0.0,
+    dropout_seed: Optional[jnp.ndarray] = None,
+    floor: float = 0.01,
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Returns ``(out, graph_sums)``; ``graph_sums`` is ΣA per (batch, head)
+    — same contract as ``sbm_attention_fused_pallas`` minus the aux
+    attention map (the aux/analysis path uses the XLA backend)."""
+    if dropout_seed is None:
+        dropout_seed = jnp.zeros((), dtype=jnp.int32)
+    seeds = jnp.stack(
+        [jnp.asarray(sample_seed, jnp.int32).reshape(()),
+         jnp.asarray(dropout_seed, jnp.int32).reshape(())]
+    )
+    return _flash(
+        q, k, v, q_hat, k_hat, s_aff, key_pad.astype(jnp.float32), seeds,
+        float(dropout_rate), float(floor),
+    )

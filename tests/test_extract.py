@@ -25,9 +25,15 @@ def find_max_value(itemsList):
 
 
 def test_identifier_splitting():
+    # reference splitter semantics (py/process_utils.py:141-193): case,
+    # digit, and special boundaries; upper-run keeps its last char as the
+    # next word's head; parts lowercased by split_identifier_into_parts
     assert split_camelcase("camelCaseHTTPWord") == ["camel", "Case", "HTTP", "Word"]
+    assert split_camelcase("value2") == ["value", "2"]
+    assert split_camelcase("HTTP2Word") == ["HTTP", "2", "Word"]
     assert split_identifier_into_parts("find_max_value") == ["find", "max", "value"]
-    assert split_identifier_into_parts("itemsList") == ["items", "List"]
+    assert split_identifier_into_parts("itemsList") == ["items", "list"]
+    assert split_identifier_into_parts("getURLPath") == ["get", "url", "path"]
     assert split_identifier_into_parts("_") == ["_"]
 
 
@@ -41,7 +47,7 @@ def test_python_extraction_schema():
     # root is the function def, and sub-token chain exists (find → max → value)
     assert nodes[0]["label"].startswith("nont:FunctionDef")
     labels = {r["label"].split(":")[1] for r in nodes}
-    assert {"find", "max", "value", "items", "List"} <= labels
+    assert {"find", "max", "value", "items", "list"} <= labels
     chain = [r for r in nodes if r["label"].split(":")[1] == "max"][0]
     assert any(c.split(":")[1] == "value" for c in chain.get("children", []))
 
@@ -56,6 +62,133 @@ def test_extraction_feeds_preprocessing():
     np.testing.assert_array_equal(L, -L.T)
     np.testing.assert_array_equal(T, -T.T)
     assert np.abs(L).sum() > 0  # tree has real ancestor structure
+
+
+class FakeCST:
+    """Vendored tree-sitter-shaped CST node — drives ``cst_to_ast_json``
+    without a grammar wheel (SURVEY §2.1 Java L0; VERDICT r2 item 7)."""
+
+    def __init__(self, type_, children=(), text=b"", start=(0, 0), end=(0, 0)):
+        self.type = type_
+        self.children = list(children)
+        self.text = text
+        self.start_point = start
+        self.end_point = end
+
+
+def _java_method_cst():
+    """`public String getUserName(String rawName) { return name0; }` plus an
+    ERROR recovery node and a numeric literal, as tree-sitter-java shapes it."""
+    n = FakeCST
+    return n("program", [
+        n("method_declaration", [
+            n("modifiers", [n("public", text=b"public")]),
+            n("type_identifier", text=b"String"),
+            n("identifier", text=b"getUserName"),
+            n("formal_parameters", [
+                n("(", text=b"("),
+                n("formal_parameter", [
+                    n("type_identifier", text=b"String"),
+                    n("identifier", text=b"rawName"),
+                ]),
+                n(")", text=b")"),
+            ]),
+            n("ERROR", [n("identifier", text=b"glitch")]),
+            n("block", [
+                n("{", text=b"{"),
+                n("return_statement", [
+                    n("return", text=b"return"),
+                    n("identifier", text=b"name0"),
+                    n(";", text=b";"),
+                ]),
+                n("expression_statement", [
+                    n("decimal_integer_literal", text=b"42"),
+                    n("string_literal", text=b'"hi there"'),
+                ]),
+                n("}", text=b"}"),
+            ]),
+        ]),
+    ])
+
+
+def test_java_cst_walk_reference_semantics():
+    from csat_tpu.data.extract import cst_to_ast_json
+
+    nodes = cst_to_ast_json(_java_method_cst(), "java")
+    labels = [r["label"] for r in nodes]
+    kinds = {(lb.split(":")[0], lb.split(":")[1]) for lb in labels}
+
+    # ERROR → parameters remap (ref java/process_utils.py:210-216)
+    assert ("nont", "parameters") in kinds
+    assert all(lb.split(":")[1] != "ERROR" for lb in labels)
+    # punctuation types skipped entirely
+    assert not any(lb.split(":")[1] in "(){};" for lb in labels)
+    # keywords become nont + raw idt terminal (ref dfs_graph else-branch)
+    assert ("nont", "return") in kinds and ("idt", "return") in kinds
+    # identifier chains: lowercased camel splits under nont:identifier
+    for tok in ("get", "user", "name"):
+        assert ("idt", tok) in kinds
+    assert ("idt", "getUserName") not in kinds
+    # name0 → ['name', '0'] (digit boundary)
+    assert ("idt", "0") in kinds
+    # string literal: nont node only, no terminal; number literal dropped
+    assert ("nont", "string_literal") in kinds
+    assert not any("hi" in lb for lb in labels)
+    assert ("idt", "42") not in kinds
+
+    # the walk feeds L1 directly
+    root = ast_json_to_tree(nodes)
+    seq = truncate_preorder(root, 50)
+    L, T = build_matrices(seq, 50)
+    np.testing.assert_array_equal(L, -L.T)
+
+
+def test_punctuation_substring_quirk():
+    """The reference's punctuation filter is a *substring* test
+    (``node.type in string.punctuation``, java/process_utils.py:210):
+    '<=' (substring of ';<=>?') is skipped wholesale while '==' (not a
+    substring) survives and emits an idt terminal. Reproduced deliberately
+    — the type vocabulary must match the reference pipeline's output."""
+    from csat_tpu.data.extract import cst_to_ast_json
+
+    cst = FakeCST("binary_expression", [
+        FakeCST("<=", text=b"<="),
+        FakeCST("==", text=b"=="),
+    ])
+    nodes = cst_to_ast_json(cst, "java")
+    kinds = {(lb.split(":")[0], lb.split(":")[1])
+             for lb in (r["label"] for r in nodes)}
+    assert not any(v == "<=" for _, v in kinds)
+    assert ("nont", "==") in kinds and ("idt", "==") in kinds
+
+
+def test_modern_grammar_string_content_drops():
+    """string_content/string_fragment leaves (modern grammars) emit no
+    terminal — raw string text must not leak into the graph."""
+    from csat_tpu.data.extract import cst_to_ast_json
+
+    cst = FakeCST("string", [FakeCST("string_content", text=b"hello world")])
+    for lang in ("python", "java"):
+        nodes = cst_to_ast_json(
+            FakeCST("program", [cst if lang == "python" else
+                                FakeCST("string_fragment", text=b"hello world")]),
+            lang,
+        )
+        assert not any("hello" in r["label"] for r in nodes)
+
+
+def test_java_identifier_chain_structure():
+    """Chain shape: each split is the child of the previous split
+    (ref java/process_utils.py:243-252)."""
+    from csat_tpu.data.extract import cst_to_ast_json
+
+    cst = FakeCST("program", [FakeCST("identifier", text=b"getUserName")])
+    nodes = cst_to_ast_json(cst, "java")
+    by_val = {r["label"].split(":")[1]: r for r in nodes}
+    assert [c.split(":")[1] for c in by_val["identifier"]["children"]] == ["get"]
+    assert [c.split(":")[1] for c in by_val["get"]["children"]] == ["user"]
+    assert [c.split(":")[1] for c in by_val["user"]["children"]] == ["name"]
+    assert "children" not in by_val["name"]
 
 
 def test_extract_corpus_files(tmp_path):

@@ -1,0 +1,186 @@
+"""Flash/block-sparse SBM kernel vs the XLA counter-noise mirror.
+
+The counter-mode contract (``csat_tpu/ops/hashrng.py``): the pallas kernel
+generates the Bernoulli stream in-kernel, the XLA path materializes the
+identical field — so the two backends sample the *same* graph and differ
+only in summation order. These tests hold forward and gradients together at
+fp32 tolerance, plus the model-level route.
+"""
+
+import math
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from csat_tpu.models.sbm import l1_normalize
+from csat_tpu.models.ste import sample_graph
+from csat_tpu.ops.hashrng import bits_to_uniform, hash_bits, uniform_field
+from csat_tpu.ops.sbm_flash_pallas import TILE, _round_up, sbm_attention_flash
+
+
+def _inputs(b=2, h=2, n=150, dh=32, kk=5, seed=0):
+    key = jax.random.key(seed)
+    ks = jax.random.split(key, 8)
+    q, k, v = (jax.random.normal(ks[i], (b, h, n, dh), jnp.float32) for i in range(3))
+    q_hat = jax.nn.sigmoid(jax.random.normal(ks[3], (b, h, n, kk)) * 2)
+    k_hat = jax.nn.sigmoid(jax.random.normal(ks[4], (b, h, n, kk)) * 2)
+    s_aff = jax.nn.softmax(
+        jax.random.normal(ks[5], (h, kk * kk)).reshape(h, kk, kk), axis=-1
+    )
+    pad = jnp.zeros((b, n), jnp.float32).at[:, n - 17 :].set(1.0)
+    return q, k, v, q_hat, k_hat, s_aff, pad
+
+
+def _xla_mirror(q, k, v, q_hat, k_hat, s_aff, pad, sample_seed,
+                rate=0.0, drop_seed=None):
+    """Reference composition with the materialized hash-noise field."""
+    b, h, n, dh = q.shape
+    noise = uniform_field(sample_seed, b, h, n, n, _round_up(n, TILE))
+    exp_a = jnp.einsum("bhnk,hkj,bhmj->bhnm", q_hat, s_aff, k_hat)
+    graph = sample_graph(exp_a, noise)
+    mask = pad[:, None, None, :].astype(bool)
+    dot = jnp.einsum("bhnd,bhmd->bhnm", q, k) / math.sqrt(dh)
+    dot = jnp.where(mask, -jnp.inf, dot)
+    attn = l1_normalize(jax.nn.softmax(dot, axis=-1) * graph)
+    if rate > 0.0:
+        rows = jax.lax.broadcasted_iota(jnp.uint32, (1, 1, n, n), 2)
+        cols = jax.lax.broadcasted_iota(jnp.uint32, (1, 1, n, n), 3)
+        bh = (
+            jax.lax.broadcasted_iota(jnp.uint32, (b, h, 1, 1), 0) * jnp.uint32(h)
+            + jax.lax.broadcasted_iota(jnp.uint32, (b, h, 1, 1), 1)
+        )
+        u = bits_to_uniform(hash_bits(drop_seed, bh, rows, cols, _round_up(n, TILE)))
+        attn = attn * jnp.where(u >= rate, 1.0 / (1.0 - rate), 0.0)
+    out = jnp.einsum("bhnm,bhmd->bhnd", attn, v)
+    graph_sums = jnp.sum(graph, axis=(2, 3))
+    return out, graph_sums
+
+
+SEED = jnp.int32(1234)
+DSEED = jnp.int32(777)
+
+
+def test_flash_forward_matches_xla_mirror():
+    args = _inputs()
+    out_p, gs_p = sbm_attention_flash(*args, SEED)
+    out_x, gs_x = _xla_mirror(*args, SEED)
+    np.testing.assert_array_equal(np.asarray(gs_p), np.asarray(gs_x))
+    np.testing.assert_allclose(np.asarray(out_p), np.asarray(out_x), atol=2e-5)
+
+
+@pytest.mark.slow
+def test_flash_forward_nonaligned_and_multitile():
+    # N=300 → 3 tiles of 128 with a ragged real region
+    args = _inputs(b=1, h=2, n=300, dh=16, kk=4, seed=3)
+    out_p, gs_p = sbm_attention_flash(*args, SEED)
+    out_x, gs_x = _xla_mirror(*args, SEED)
+    np.testing.assert_array_equal(np.asarray(gs_p), np.asarray(gs_x))
+    np.testing.assert_allclose(np.asarray(out_p), np.asarray(out_x), atol=2e-5)
+
+
+@pytest.mark.slow
+def test_flash_grads_match_xla_mirror():
+    args = _inputs(b=1, h=2, n=140, dh=16, kk=4, seed=1)
+    q, k, v, q_hat, k_hat, s_aff, pad = args
+    go = jax.random.normal(jax.random.key(9), q.shape)
+
+    def loss(fn, *xs):
+        out, gs = fn(*xs)
+        return jnp.sum(out * go) + 1e-3 * jnp.sum(gs)
+
+    f_p = lambda q, k, v, qh, kh, s: loss(
+        lambda *a: sbm_attention_flash(*a, pad, SEED), q, k, v, qh, kh, s)
+    f_x = lambda q, k, v, qh, kh, s: loss(
+        lambda *a: _xla_mirror(*a, pad, SEED), q, k, v, qh, kh, s)
+    gp = jax.grad(f_p, argnums=(0, 1, 2, 3, 4, 5))(q, k, v, q_hat, k_hat, s_aff)
+    gx = jax.grad(f_x, argnums=(0, 1, 2, 3, 4, 5))(q, k, v, q_hat, k_hat, s_aff)
+    for a, b, name in zip(gp, gx, "q k v q_hat k_hat s_aff".split()):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), atol=3e-5, err_msg=name
+        )
+
+
+@pytest.mark.slow
+def test_flash_dropout_fwd_bwd_match_mirror():
+    args = _inputs(b=1, h=2, n=150, dh=16, kk=4, seed=2)
+    q, k, v, q_hat, k_hat, s_aff, pad = args
+    rate = 0.3
+    out_p, _ = sbm_attention_flash(*args, SEED, rate, DSEED)
+    out_x, _ = _xla_mirror(*args, SEED, rate, DSEED)
+    np.testing.assert_allclose(np.asarray(out_p), np.asarray(out_x), atol=2e-5)
+
+    go = jax.random.normal(jax.random.key(5), q.shape)
+    f_p = lambda v_: jnp.sum(
+        sbm_attention_flash(q, k, v_, q_hat, k_hat, s_aff, pad, SEED, rate, DSEED)[0] * go)
+    f_x = lambda v_: jnp.sum(
+        _xla_mirror(q, k, v_, q_hat, k_hat, s_aff, pad, SEED, rate, DSEED)[0] * go)
+    np.testing.assert_allclose(
+        np.asarray(jax.grad(f_p)(v)), np.asarray(jax.grad(f_x)(v)), atol=3e-5
+    )
+
+
+def test_flash_under_jit():
+    args = _inputs(b=1, h=1, n=64, dh=16, kk=3, seed=4)
+    fn = jax.jit(lambda *a: sbm_attention_flash(*a, SEED))
+    out, gs = fn(*args)
+    assert out.shape == (1, 1, 64, 16)
+    assert np.isfinite(np.asarray(out)).all()
+    out2, gs2 = fn(*args)
+    np.testing.assert_array_equal(np.asarray(out), np.asarray(out2))
+
+
+@pytest.mark.slow
+def test_model_counter_mode_backend_parity(tiny_config, synthetic_corpus):
+    """Full model forward: backend=pallas/counter ≡ backend=xla/counter."""
+    from csat_tpu.data.dataset import ASTDataset, iterate_batches
+    from csat_tpu.data.vocab import load_vocab
+    from csat_tpu.train.state import make_model
+
+    sv, tv = load_vocab(synthetic_corpus)
+    cfg_x = tiny_config.replace(
+        data_dir=synthetic_corpus, noise_mode="counter", backend="xla")
+    cfg_p = cfg_x.replace(backend="pallas")
+    ds = ASTDataset(cfg_x, "train", sv, tv)
+    batch = next(iterate_batches(ds, 4, shuffle=False))
+    rngs = {"params": jax.random.key(0), "sample": jax.random.key(1),
+            "dropout": jax.random.key(2)}
+    model_x = make_model(cfg_x, sv.size(), tv.size())
+    model_p = make_model(cfg_p, sv.size(), tv.size())
+    vars_x = model_x.init(rngs, batch, deterministic=True)
+    out_x, sp_x, *_ = model_x.apply(
+        vars_x, batch, deterministic=True, rngs={"sample": jax.random.key(7)})
+    out_p, sp_p, *_ = model_p.apply(
+        vars_x, batch, deterministic=True, rngs={"sample": jax.random.key(7)})
+    np.testing.assert_allclose(
+        np.asarray(sp_x), np.asarray(sp_p), rtol=0, atol=1e-6)
+    np.testing.assert_allclose(
+        np.asarray(out_x), np.asarray(out_p), atol=5e-5)
+
+
+@pytest.mark.slow
+def test_model_counter_train_step(tiny_config, synthetic_corpus):
+    """One SBM train step on pallas+counter: finite loss, cluster grads flow."""
+    from csat_tpu.data.dataset import ASTDataset, iterate_batches
+    from csat_tpu.data.vocab import load_vocab
+    from csat_tpu.train import default_optimizer, make_train_step
+    from csat_tpu.train.state import create_train_state, make_model
+
+    cfg = tiny_config.replace(
+        data_dir=synthetic_corpus, backend="pallas", noise_mode="counter")
+    sv, tv = load_vocab(synthetic_corpus)
+    ds = ASTDataset(cfg, "train", sv, tv)
+    batch = next(iterate_batches(ds, cfg.batch_size, shuffle=False))
+    model = make_model(cfg, sv.size(), tv.size())
+    tx = default_optimizer(cfg)
+    state = create_train_state(model, tx, batch, seed=0)
+    step = make_train_step(model, tx, cfg)
+    before = np.array(
+        state.params["encoder"]["transformer_0"]["SBMAttention_0"]["clusters"])
+    state, metrics = step(state, batch)
+    assert np.isfinite(float(metrics["loss"]))
+    assert 0.0 < float(metrics["sparsity"]) < 1.0
+    after = np.asarray(
+        state.params["encoder"]["transformer_0"]["SBMAttention_0"]["clusters"])
+    assert not np.array_equal(before, after)

@@ -1,0 +1,110 @@
+"""Mosaic-compiled kernel tier — requires a real TPU (``pytest -m tpu``).
+
+Off-TPU the Pallas kernels run under the CPU interpreter
+(``csat_tpu/ops/sbm_pallas.py:_interpret``); this tier proves the same
+kernel code compiles and agrees with the XLA backend *under Mosaic* on a
+chip (VERDICT r2 item 2). It intentionally reuses the interpret-mode test
+bodies — the only new information is the compiler.
+
+Run on TPU hardware with::
+
+    CSAT_TPU_TESTS=1 python -m pytest tests/test_ops_tpu.py -m tpu -q
+"""
+
+import numpy as np
+import pytest
+
+pytestmark = pytest.mark.tpu
+
+
+@pytest.fixture(scope="module", autouse=True)
+def require_tpu():
+    # tests/conftest.py forces the cpu platform for the virtual-mesh tiers;
+    # this tier needs the real chip. Gated on an explicit env opt-in so a
+    # plain `-m "not slow"` run on a TPU VM (which overrides pytest.ini's
+    # `-m "not tpu"` addopts) can never re-point jax mid-suite.
+    import os
+
+    if not os.environ.get("CSAT_TPU_TESTS"):
+        pytest.skip("set CSAT_TPU_TESTS=1 to run the Mosaic tier")
+    import jax
+
+    jax.config.update("jax_platforms", "")
+    if jax.default_backend() != "tpu":
+        pytest.skip("no TPU backend available")
+    yield
+    jax.config.update("jax_platforms", "cpu")
+
+
+def test_flash_kernel_compiles_under_mosaic():
+    from tests.test_flash_ops import SEED, _inputs, _xla_mirror
+    from csat_tpu.ops.sbm_flash_pallas import sbm_attention_flash
+
+    args = _inputs(b=2, h=2, n=150, dh=64, kk=10)
+    out_p, gs_p = sbm_attention_flash(*args, SEED)
+    out_x, gs_x = _xla_mirror(*args, SEED)
+    np.testing.assert_array_equal(np.asarray(gs_p), np.asarray(gs_x))
+    np.testing.assert_allclose(np.asarray(out_p), np.asarray(out_x), atol=5e-4)
+
+
+def test_flash_grads_under_mosaic():
+    import jax
+    import jax.numpy as jnp
+
+    from tests.test_flash_ops import SEED, _inputs, _xla_mirror
+    from csat_tpu.ops.sbm_flash_pallas import sbm_attention_flash
+
+    q, k, v, q_hat, k_hat, s_aff, pad = _inputs(b=1, h=2, n=150, dh=64, kk=10)
+    go = jax.random.normal(jax.random.key(9), q.shape)
+
+    def loss(fn):
+        def inner(q, k, v, qh, kh, s):
+            out, gs = fn(q, k, v, qh, kh, s, pad, SEED)
+            return jnp.sum(out * go) + 1e-3 * jnp.sum(gs)
+
+        return inner
+
+    gp = jax.grad(loss(sbm_attention_flash), argnums=(0, 1, 2, 3, 4, 5))(
+        q, k, v, q_hat, k_hat, s_aff)
+    gx = jax.grad(loss(_xla_mirror), argnums=(0, 1, 2, 3, 4, 5))(
+        q, k, v, q_hat, k_hat, s_aff)
+    for a, b, name in zip(gp, gx, "q k v q_hat k_hat s_aff".split()):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), atol=1e-3, err_msg=name)
+
+
+def test_long_ast_512_step_on_tpu():
+    """N=512 (the long-AST north star) fits VMEM tiling and runs fwd+bwd."""
+    import jax
+    import jax.numpy as jnp
+
+    from tests.test_flash_ops import SEED, _inputs
+    from csat_tpu.ops.sbm_flash_pallas import sbm_attention_flash
+
+    q, k, v, q_hat, k_hat, s_aff, pad = _inputs(b=8, h=8, n=512, dh=64, kk=10)
+
+    def loss(q, k, v):
+        out, gs = sbm_attention_flash(q, k, v, q_hat, k_hat, s_aff, pad, SEED)
+        return jnp.sum(out) + 1e-3 * jnp.sum(gs)
+
+    val, grads = jax.jit(jax.value_and_grad(loss, argnums=(0, 1, 2)))(q, k, v)
+    assert np.isfinite(float(val))
+    for g in grads:
+        assert np.isfinite(np.asarray(g)).all()
+
+
+def test_legacy_kernels_under_mosaic():
+    """The whole-block kernels (sbm_pallas) also compile on-chip at N=150."""
+    import jax
+
+    from csat_tpu.models.ste import bernoulli_noise
+    from csat_tpu.ops.sbm_pallas import sbm_attention_pallas
+
+    key = jax.random.key(0)
+    ks = jax.random.split(key, 4)
+    b, h, n, dh = 2, 2, 150, 64
+    q, k, v = (jax.random.normal(ks[i], (b, h, n, dh)) for i in range(3))
+    graph = (bernoulli_noise(ks[3], (b, h, n, n)) < 0.3).astype(np.float32)
+    pad = np.zeros((b, n), np.float32)
+    out, attn = sbm_attention_pallas(q, k, v, graph, pad)
+    assert np.isfinite(np.asarray(out)).all()
