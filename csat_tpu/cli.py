@@ -29,6 +29,10 @@ def main() -> None:
     p.add_argument("--batch_size", type=int, default=0)
     p.add_argument("--is_test", action="store_true", help="skip training, evaluate a checkpoint")
     p.add_argument("--checkpoint_dir", default="", help="orbax checkpoint dir for --is_test/resume")
+    p.add_argument("--resume", action="store_true",
+                   help="resume from the newest full-state checkpoint in the output dir")
+    p.add_argument("--profile", action="store_true",
+                   help="emit a jax.profiler trace for the first epoch")
     p.add_argument("--backend", default="", choices=["", "xla", "pallas"])
     p.add_argument("--platform", default="", help="force jax platform (cpu/tpu)")
     args = p.parse_args()
@@ -51,6 +55,9 @@ def main() -> None:
         overrides["batch_size"] = args.batch_size
     if args.backend:
         overrides["backend"] = args.backend
+    if args.profile:
+        overrides["profile"] = True
+    overrides["scalar_log"] = True  # the CLI always streams scalars.jsonl
     cfg = get_config(args.config, **overrides)
 
     trainer = Trainer(cfg)
@@ -73,7 +80,10 @@ def main() -> None:
     from csat_tpu.train.checkpoint import make_checkpoint_fn, save_params
 
     ckpt_fn = make_checkpoint_fn(trainer.output_dir)
-    state, history = trainer.fit(train_ds, val_ds, checkpoint_fn=ckpt_fn)
+    # --resume honors an explicit --checkpoint_dir, else the output dir
+    resume = (args.checkpoint_dir or True) if args.resume else False
+    state, history = trainer.fit(
+        train_ds, val_ds, checkpoint_fn=ckpt_fn, resume=resume)
     # persist the best-by-val-BLEU weights (ref best_model file, train.py:200-208)
     save_params(trainer.output_dir, history["best_params"])
     scores = run_test(

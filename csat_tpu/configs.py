@@ -98,6 +98,9 @@ class Config:
     remat: bool = False
     # reference-compat quirk flags (SURVEY.md §8) — default reproduces
     generator_dropout: bool = True  # dropout-before-softmax Generator quirk
+    # observability (cli --profile / scalars.jsonl stream; SURVEY §5)
+    scalar_log: bool = False
+    profile: bool = False
 
     @property
     def head_dim(self) -> int:

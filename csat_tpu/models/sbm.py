@@ -118,7 +118,7 @@ class SBMAttention(nn.Module):
             # path generates it in-kernel tile-by-tile — no (B,H,N,N) noise
             # tensor in HBM; the XLA path materializes the identical field so
             # the two backends sample the identical graph
-            from csat_tpu.ops.sbm_flash_pallas import TILE, _round_up
+            from csat_tpu.ops.hashrng import noise_stride
 
             sample_seed = draw_seed("sample")
             if self.backend == "pallas" and not need_aux:
@@ -131,7 +131,7 @@ class SBMAttention(nn.Module):
                 return out, head_sparsity(graph_sums), None, None
             from csat_tpu.ops.hashrng import uniform_field
 
-            noise = uniform_field(sample_seed, b, h, n, n, _round_up(n, TILE))
+            noise = uniform_field(sample_seed, b, h, n, n, noise_stride(n))
         else:
             noise = bernoulli_noise(self.make_rng("sample"), (b, h, n, n))
         if self.backend == "pallas" and not need_aux:

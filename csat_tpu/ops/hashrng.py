@@ -21,7 +21,17 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 
-__all__ = ["hash_bits", "bits_to_uniform", "uniform_field"]
+__all__ = ["hash_bits", "bits_to_uniform", "uniform_field", "noise_stride", "TILE"]
+
+# node-axis tile edge of the flash kernel; the hash row-stride is the
+# kernel's padded N, so both the in-kernel and materialized streams MUST
+# derive it from here
+TILE = 128
+
+
+def noise_stride(n: int) -> int:
+    """Row stride of the (i, j) hash counter = N padded to the tile edge."""
+    return (n + TILE - 1) // TILE * TILE
 
 _C1 = 0x9E3779B9  # golden-ratio mix for the seed
 _C2 = 0x85EBCA6B  # murmur3 constant, mixes batch·head

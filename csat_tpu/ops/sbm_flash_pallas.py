@@ -59,10 +59,12 @@ from jax.dtypes import float0
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-from csat_tpu.ops.hashrng import bits_to_uniform, hash_bits
+from csat_tpu.ops.hashrng import TILE, bits_to_uniform, hash_bits, noise_stride
 from csat_tpu.ops.sbm_pallas import _interpret
 
-TILE = 128  # q/k tile edge — MXU/lane aligned
+# TILE (the q/k tile edge, MXU/lane aligned) lives in hashrng — the hash
+# stream's row stride is the TILE-padded N on both the in-kernel and the
+# materialized XLA path
 KPAD = 128  # cluster axis padded to one lane tile
 BIG = 1e30
 

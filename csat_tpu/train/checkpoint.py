@@ -19,7 +19,10 @@ import orbax.checkpoint as ocp
 
 from csat_tpu.train.state import TrainState
 
-__all__ = ["save_state", "restore_state", "save_params", "restore_params", "make_checkpoint_fn"]
+__all__ = [
+    "save_state", "restore_state", "restore_latest", "save_params",
+    "restore_params", "make_checkpoint_fn", "latest_step",
+]
 
 
 def _mgr(directory: str) -> ocp.CheckpointManager:
@@ -58,6 +61,25 @@ def restore_state(directory: str, example: TrainState, step: Optional[int] = Non
     return TrainState(
         step=restored.step, params=restored.params, opt_state=restored.opt_state, rng=rng
     )
+
+
+def latest_step(directory: str) -> Optional[int]:
+    """Latest checkpointed step/epoch under ``directory``, or None."""
+    if not os.path.isdir(directory):
+        return None
+    mgr = _mgr(directory)
+    step = mgr.latest_step()
+    mgr.close()
+    return step
+
+
+def restore_latest(directory: str, example: TrainState):
+    """→ ``(state, epoch)`` from the newest checkpoint (the ``--resume``
+    surface; the reference can only re-load model weights,
+    ``csa_trans.py:176-177`` — optimizer/RNG state is lost there)."""
+    step = latest_step(directory)
+    assert step is not None, f"no checkpoints under {directory}"
+    return restore_state(directory, example, step), step
 
 
 def save_params(directory: str, params: Any, name: str = "best_model") -> None:

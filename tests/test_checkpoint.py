@@ -44,3 +44,40 @@ def test_params_roundtrip(tmp_path, tiny_config):
     params = restore_params(str(tmp_path))
     for a, b in zip(jax.tree.leaves(state.params), jax.tree.leaves(params)):
         np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+@pytest.mark.slow
+def test_kill_and_resume_reproduces_loss_curve(tiny_config, synthetic_corpus, tmp_path):
+    """Full-state resume (VERDICT r2 item 10): a run killed after its
+    epoch-2 checkpoint and resumed via Trainer.fit(resume=True) must emit
+    the same epoch-3/4 losses as the uninterrupted run — params, AdamW
+    moments, RNG and the seed-per-epoch shuffle all restore exactly."""
+    from csat_tpu.data.dataset import ASTDataset
+    from csat_tpu.train import Trainer
+    from csat_tpu.train.checkpoint import make_checkpoint_fn
+
+    def cfg_for(sub):
+        return tiny_config.replace(
+            data_dir=synthetic_corpus, full_att=True, num_epochs=4,
+            val_interval=99, save_interval=2, dropout=0.1,
+            attention_dropout=0.0, output_dir=str(tmp_path / sub),
+        )
+
+    cfg_a = cfg_for("uninterrupted")
+    tr_a = Trainer(cfg_a, log=lambda s: None)
+    ds = ASTDataset(cfg_a, "train", tr_a.src_vocab, tr_a.tgt_vocab)
+    _, hist_a = tr_a.fit(ds, None, checkpoint_fn=make_checkpoint_fn(tr_a.output_dir))
+
+    cfg_b = cfg_for("resumed")
+    tr_b1 = Trainer(cfg_b, log=lambda s: None)
+    tr_b1.fit(ds, None, num_epochs=2,
+              checkpoint_fn=make_checkpoint_fn(tr_b1.output_dir))
+    # "kill" — then a brand-new Trainer resumes from the checkpoint
+    tr_b2 = Trainer(cfg_b, log=lambda s: None)
+    _, hist_b = tr_b2.fit(ds, None, resume=True,
+                          checkpoint_fn=make_checkpoint_fn(tr_b2.output_dir))
+
+    np.testing.assert_allclose(
+        hist_b["loss"], hist_a["loss"][2:], rtol=1e-6,
+        err_msg="resumed continuation diverged from the uninterrupted curve",
+    )

@@ -4,6 +4,7 @@ import jax
 import numpy as np
 import pytest
 
+from csat_tpu.data.dataset import ASTDataset, iterate_batches
 from csat_tpu.parallel.dryrun import dryrun_train_step
 from csat_tpu.parallel.mesh import build_mesh, param_sharding, PARAM_RULES
 from jax.sharding import PartitionSpec as P
@@ -147,3 +148,66 @@ def test_trainer_fit_runs_under_seq_mesh(synthetic_corpus):
         ASTDataset(cfg, "train", tr.src_vocab, tr.tgt_vocab), num_epochs=1
     )
     assert np.isfinite(history["loss"][0])
+
+
+@pytest.mark.slow
+def test_sharded_eval_matches_unsharded(tiny_config, synthetic_corpus):
+    """Decode + BLEU under an 8-device dp mesh ≡ single-device (VERDICT r2
+    item 6): the eval path shards batches over `data` instead of funnelling
+    through one device, and the accumulator reduction changes nothing."""
+    from csat_tpu.data.vocab import load_vocab
+    from csat_tpu.parallel import build_mesh
+    from csat_tpu.train.loop import evaluate_bleu
+    from csat_tpu.train.state import make_model
+
+    cfg = tiny_config.replace(
+        data_dir=synthetic_corpus, full_att=True, batch_size=8)
+    sv, tv = load_vocab(synthetic_corpus)
+    ds = ASTDataset(cfg, "dev", sv, tv)
+    model = make_model(cfg, sv.size(), tv.size())
+    batch = next(iterate_batches(ds, 8, shuffle=False))
+    variables = model.init(
+        {"params": jax.random.key(0), "sample": jax.random.key(1)},
+        batch, deterministic=True)
+    key = jax.random.key(3)
+    mesh1 = build_mesh((("data", 1),))
+    mesh8 = build_mesh((("data", 8),))
+    b1 = evaluate_bleu(model, variables["params"], ds, cfg, tv, key, mesh=mesh1)
+    b8 = evaluate_bleu(model, variables["params"], ds, cfg, tv, key, mesh=mesh8)
+    assert b1 == pytest.approx(b8, abs=1e-9)
+
+
+def test_tail_batch_does_not_recompile(tiny_config, synthetic_corpus):
+    """24 dev samples at batch 16 → one full + one ragged batch; the padded
+    eval path must reuse ONE compiled decode program (the old path re-jitted
+    on the 8-row tail)."""
+    from csat_tpu.data.vocab import load_vocab
+    from csat_tpu.train.loop import _decode_dataset
+    from csat_tpu.train.state import make_model
+
+    cfg = tiny_config.replace(
+        data_dir=synthetic_corpus, full_att=True, batch_size=16)
+    sv, tv = load_vocab(synthetic_corpus)
+    ds = ASTDataset(cfg, "dev", sv, tv)  # 24 samples
+    model = make_model(cfg, sv.size(), tv.size())
+    batch = next(iterate_batches(ds, 16, shuffle=False))
+    variables = model.init(
+        {"params": jax.random.key(0), "sample": jax.random.key(1)},
+        batch, deterministic=True)
+
+    traces = []
+
+    @jax.jit
+    def decode_fn(params, b, key):
+        traces.append(1)  # python body runs only when (re)tracing
+        from csat_tpu.train.decode import greedy_decode
+
+        return greedy_decode(model, {"params": params}, b, key)
+
+    rows = [
+        yp.shape[0]
+        for yp, _ in _decode_dataset(
+            model, variables["params"], ds, cfg, jax.random.key(0), decode_fn)
+    ]
+    assert rows == [16, 8]  # ragged tail came back trimmed
+    assert len(traces) == 1, f"tail batch re-traced the decode ({len(traces)}x)"
