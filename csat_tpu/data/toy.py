@@ -31,12 +31,15 @@ def random_batch(
     n_real = n_real_nodes or n
     src = rng.integers(4, src_vocab_size, (batch_size, n))
     src[:, n_real:] = 0
-    # plausible raw distances: small signed ints, zero diagonal
+    # plausible raw distances: small signed ints, zero diagonal, and
+    # ANTISYMMETRIC like the real L/T matrices (my_ast.py:198-273 emits
+    # L[i,j] = -L[j,i]) — real collate derives a symmetric adj=|L|<=1 from
+    # this, and the laplacian path assumes that symmetry
     raw_l = rng.integers(-6, 7, (batch_size, n, n)).astype(np.int32)
     raw_t = rng.integers(-4, 5, (batch_size, n, n)).astype(np.int32)
     for m in (raw_l, raw_t):
-        di = np.arange(n)
-        m[:, di, di] = 0
+        upper = np.triu(m, k=1)
+        m[:] = upper - upper.transpose(0, 2, 1)
     off, hi = n // 2, n - 1
     tgt = rng.integers(4, tgt_vocab_size, (batch_size, t))
     tp_dim = cfg.tree_pos_width * cfg.tree_pos_height

@@ -235,7 +235,9 @@ def normalize_tokens(tokens: Sequence[str]) -> List[str]:
         cur = ""
         cur_alnum: Optional[bool] = None
         for ch in tok:
-            is_alnum = ch.isalnum() or ch in "<>_"
+            # '_' stays a word char (snake_case tokens); sentinels are
+            # already handled whole above, so '<'/'>' split like punctuation
+            is_alnum = ch.isalnum() or ch == "_"
             if cur and is_alnum != cur_alnum:
                 out.append(cur)
                 cur = ""

@@ -73,11 +73,13 @@ def latest_step(directory: str) -> Optional[int]:
     return step
 
 
-def restore_latest(directory: str, example: TrainState):
+def restore_latest(directory: str, example: TrainState, step: Optional[int] = None):
     """→ ``(state, epoch)`` from the newest checkpoint (the ``--resume``
     surface; the reference can only re-load model weights,
-    ``csa_trans.py:176-177`` — optimizer/RNG state is lost there)."""
-    step = latest_step(directory)
+    ``csa_trans.py:176-177`` — optimizer/RNG state is lost there). Pass a
+    known ``step`` to skip re-scanning the directory."""
+    if step is None:
+        step = latest_step(directory)
     assert step is not None, f"no checkpoints under {directory}"
     return restore_state(directory, example, step), step
 

@@ -275,8 +275,9 @@ class Trainer:
                 resume if isinstance(resume, str) and resume
                 else os.path.join(self.output_dir, "checkpoints")
             )
-            if latest_step(ckpt_dir) is not None:
-                state, done_epoch = restore_latest(ckpt_dir, state)
+            found = latest_step(ckpt_dir)
+            if found is not None:
+                state, done_epoch = restore_latest(ckpt_dir, state, found)
                 start_epoch = done_epoch + 1
                 self.log(f"resumed from epoch {done_epoch} ({ckpt_dir})")
                 # carry the pre-kill best-by-val-BLEU forward so the resumed
@@ -336,9 +337,10 @@ class Trainer:
             if checkpoint_fn is not None and epoch % cfg.save_interval == 0:
                 checkpoint_fn(state, epoch)
             self.log(msg)
-        if best_params is None and os.path.exists(best_meta):
+        if best_params is None and resume and os.path.exists(best_meta):
             # resumed run that never beat the pre-kill best: the on-disk
-            # best_model is still the winner
+            # best_model is still the winner (a FRESH run in a reused output
+            # dir must not inherit a previous run's weights)
             from csat_tpu.train.checkpoint import restore_params
 
             best_params = restore_params(self.output_dir)
