@@ -276,7 +276,8 @@ class Trainer:
                 else os.path.join(self.output_dir, "checkpoints")
             )
             found = latest_step(ckpt_dir)
-            if found is not None:
+            resumed = found is not None
+            if resumed:
                 state, done_epoch = restore_latest(ckpt_dir, state, found)
                 start_epoch = done_epoch + 1
                 self.log(f"resumed from epoch {done_epoch} ({ckpt_dir})")
@@ -287,6 +288,8 @@ class Trainer:
                         best_bleu = float(json.load(f).get("bleu", 0.0))
             else:
                 self.log(f"no checkpoint under {ckpt_dir}; starting fresh")
+        else:
+            resumed = False
         eval_key = jax.random.key(cfg.seed + 777)
         history: Dict[str, Any] = {"loss": [], "val_bleu": [], "best_bleu": best_bleu}
         for epoch in range(start_epoch, num_epochs + 1):
@@ -337,10 +340,11 @@ class Trainer:
             if checkpoint_fn is not None and epoch % cfg.save_interval == 0:
                 checkpoint_fn(state, epoch)
             self.log(msg)
-        if best_params is None and resume and os.path.exists(best_meta):
+        if best_params is None and resumed and os.path.exists(best_meta):
             # resumed run that never beat the pre-kill best: the on-disk
-            # best_model is still the winner (a FRESH run in a reused output
-            # dir must not inherit a previous run's weights)
+            # best_model is still the winner (a FRESH run — including a
+            # resume request that found no checkpoint — must not inherit a
+            # previous run's weights)
             from csat_tpu.train.checkpoint import restore_params
 
             best_params = restore_params(self.output_dir)

@@ -211,3 +211,33 @@ def test_tail_batch_does_not_recompile(tiny_config, synthetic_corpus):
     ]
     assert rows == [16, 8]  # ragged tail came back trimmed
     assert len(traces) == 1, f"tail batch re-traced the decode ({len(traces)}x)"
+
+
+@pytest.mark.slow
+def test_long_ast_512_train_step():
+    """The long-AST north star actually EXECUTES at N=512: one train step of
+    a (small-dim) python_long-shaped config — seq-sharded node axis, remat,
+    counter noise — on the virtual 8-device mesh (r2 verdict row 42: 'an
+    unexecuted config is a plan, not a capability')."""
+    from csat_tpu.parallel.dryrun import dryrun_train_step, tiny_multichip_config
+
+    cfg = tiny_multichip_config(8, data=2, model_par=2, seq_par=2).replace(
+        max_src_len=512, noise_mode="counter", remat=True, batch_size=4,
+    )
+    loss, info = dryrun_train_step(8, model_par=2, seq_par=2, cfg=cfg)
+    assert np.isfinite(loss)
+    assert info["mesh"] == {"data": 2, "model": 2, "seq": 2}
+
+
+@pytest.mark.slow
+def test_pallas_flash_under_dp_mesh():
+    """The flash kernel composes with data-parallel sharding: batch sharded
+    over 8 devices, pallas_call partitioned per shard (r2 verdict row 35:
+    'pallas x sharding untested')."""
+    from csat_tpu.parallel.dryrun import dryrun_train_step, tiny_multichip_config
+
+    cfg = tiny_multichip_config(8, data=8, model_par=1).replace(
+        backend="pallas", noise_mode="counter", num_heads=4,
+    )
+    loss, info = dryrun_train_step(8, model_par=1, cfg=cfg)
+    assert np.isfinite(loss)

@@ -417,7 +417,6 @@ def test_label_smoothing_parity(ref):
 # remaining PE variants + full_att (VERDICT r2 item 9)
 # --------------------------------------------------------------------------
 
-_sbm_params_variant = sbm_params
 
 
 def _variant_pair(ref, cfg, variant, full_att=False, trip=1246,
@@ -442,7 +441,7 @@ def _variant_pair(ref, cfg, variant, full_att=False, trip=1246,
     params = {
         "src_embedding": _emb(sd, "src_embedding"),
         "tgt_embedding": _emb(sd, "tgt_embedding"),
-        "encoder": _sbm_params_variant(
+        "encoder": sbm_params(
             sd, sbm_layers, sequential=variant == "sequential", full_att=full_att),
         "decoder": decoder_params(sd, 4, HID),
         "generator": {"Dense_0": _lin(sd, "generator.linear")},
@@ -478,7 +477,6 @@ def test_full_att_forward_parity(ref, cfg, batch, monkeypatch):
     match that."""
     tm, cfg2, fm, params = _variant_pair(
         ref, cfg, "pegen", full_att=True, sbm_layers=4)
-    params["src_pe_embedding"] = _emb(tm.state_dict(), "src_pe_embedding")
     out_t, sp_t, out_f, sp_f = _forward_both(
         ref, tm, fm, params, batch, monkeypatch, [])
     assert sp_t == sp_f == 1.0
