@@ -126,6 +126,17 @@ class Config:
         assert self.backend in ("xla", "pallas"), self.backend
         assert self.noise_mode in ("shared", "counter"), self.noise_mode
         if self.backend == "pallas":
+            for name, size in self.mesh_shape:
+                if name == "seq" and size != 1:
+                    # the pallas kernels hold whole q/k-tiles per program and
+                    # have no cross-shard (ring) exchange; sequence-sharded
+                    # long-AST configs must use the XLA backend, whose
+                    # einsums shard via compiler-inserted collectives
+                    raise ValueError(
+                        "backend='pallas' does not support a sharded 'seq' "
+                        "mesh axis; use backend='xla' for sequence-parallel "
+                        "configs"
+                    )
             import importlib.util
 
             if importlib.util.find_spec("csat_tpu.ops") is None:

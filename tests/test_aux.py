@@ -78,3 +78,19 @@ def test_preprocess_ignore_idx_idempotent(tmp_path):
         assert n == 3
         nls = [l for l in open(os.path.join(d, "nl.original")).read().split("\n") if l]
         assert nls == ["adds 0", "adds 2", "adds 4"]
+
+
+def test_pallas_rejects_seq_sharding():
+    """The pallas kernels have no cross-shard ring exchange; a sharded seq
+    axis must be rejected up front rather than silently mis-sharding."""
+    import pytest
+
+    from csat_tpu.configs import get_config
+
+    with pytest.raises(ValueError, match="seq"):
+        get_config(
+            "python", backend="pallas",
+            mesh_shape=(("data", 2), ("seq", 2)),
+        )
+    # seq axis of size 1 stays legal (degenerate mesh)
+    get_config("python", backend="pallas", mesh_shape=(("data", 2), ("seq", 1)))

@@ -44,7 +44,13 @@ def main() -> None:
     ap.add_argument("--batch", type=int, default=64)
     ap.add_argument("--reps", type=int, default=20)
     ap.add_argument("--steps", type=int, default=8, help="batches per rep")
+    ap.add_argument("--platform", default="",
+                    help="force a jax platform (e.g. cpu) pre-backend-init")
     args = ap.parse_args()
+    if args.platform:
+        # jax is already imported at module top, so only the config update
+        # takes effect in-process (the env var would be a no-op here)
+        jax.config.update("jax_platforms", args.platform)
 
     from csat_tpu.configs import get_config
     from csat_tpu.data.toy import random_batch

@@ -35,6 +35,9 @@ def main() -> None:
     p.add_argument("--learning_rate", type=float, default=3e-4)
     p.add_argument("--out", default="./outputs/real_stdlib")
     p.add_argument("--val_interval", type=int, default=4)
+    p.add_argument("--save_interval", type=int, default=4)
+    p.add_argument("--resume", action="store_true",
+                   help="continue from the newest checkpoint in the output dir")
     p.add_argument("--platform", default="cpu",
                    help="jax platform; the bounded-budget run is CPU-sized")
     args = p.parse_args()
@@ -67,6 +70,7 @@ def main() -> None:
         num_epochs=args.epochs,
         learning_rate=args.learning_rate,
         val_interval=args.val_interval,
+        save_interval=args.save_interval,
         output_dir=args.out,
     )
 
@@ -87,8 +91,13 @@ def main() -> None:
     log(f"variant={args.variant} train={len(train_ds)} dev={len(val_ds)} "
         f"test={len(test_ds)} epochs={args.epochs}")
 
+    from csat_tpu.train.checkpoint import make_checkpoint_fn
+
     t0 = time.time()
-    state, history = trainer.fit(train_ds, val_ds)
+    state, history = trainer.fit(
+        train_ds, val_ds, checkpoint_fn=make_checkpoint_fn(trainer.output_dir),
+        resume=args.resume,
+    )
     log(f"training done in {time.time() - t0:.0f}s best_bleu={history['best_bleu']:.4f}")
 
     scores = run_test(
