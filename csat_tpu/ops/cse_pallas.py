@@ -33,6 +33,8 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from csat_tpu.ops.hashrng import round_up
+
 NEG = -1e9
 
 
@@ -60,27 +62,67 @@ def _xla_forward(q, k, v, rel_q, rel_k, rel2, mask2_f32):
     return jnp.einsum("bhnm,bhmd->bhnd", attn, v)
 
 
-def _fwd_kernel(q_ref, k_ref, v_ref, lq_ref, lk_ref, rel_ref, mask_ref, out_ref):
-    q = q_ref[0, 0]        # (N, dk)
+LANE = 128  # Mosaic's dynamic-gather unit spans one vreg along the lane axis
+
+
+def _lane_gather(table, idx):
+    """``take_along_axis(table, idx, axis=1)`` under Mosaic's gather limits.
+
+    Mosaic lowers a lane-axis ``dynamic_gather`` only when (a) the source
+    spans a single vreg along the gather dimension and (b) the source and
+    index shapes are identical. Both the (N_pad, R_pad) table and the
+    (N_pad, N_pad) index field are therefore swept in 128-lane chunks
+    (static unroll): each index chunk rebases its values into each table
+    chunk's window, gathers with clamped local indices, and a range mask
+    selects the table chunk that actually held the index. All extents are
+    lane-multiples — the caller pads.
+    """
+    chunks = []
+    for jc in range(idx.shape[1] // LANE):
+        idx_j = idx[:, jc * LANE:(jc + 1) * LANE]
+        out_j = jnp.zeros(idx_j.shape, jnp.float32)
+        for c in range(table.shape[1] // LANE):
+            local = idx_j - c * LANE
+            hit = (local >= 0) & (local < LANE)
+            g = jnp.take_along_axis(
+                table[:, c * LANE:(c + 1) * LANE],
+                jnp.clip(local, 0, LANE - 1), axis=1,
+            )
+            out_j = jnp.where(hit, g, out_j)
+        chunks.append(out_j)
+    return jnp.concatenate(chunks, axis=1) if len(chunks) > 1 else chunks[0]
+
+
+def _fwd_kernel(
+    q_ref, k_ref, v_ref, lq_ref, lk_ref, rel_ref, mask_ref, out_ref,
+    *, n_real: int,
+):
+    q = q_ref[0, 0]        # (N_pad, dk)
     k = k_ref[0, 0]
     v = v_ref[0, 0]
-    lq = lq_ref[0]         # (R, dk)
+    lq = lq_ref[0]         # (R_pad, dk), zero-padded past R
     lk = lk_ref[0]
-    rel = rel_ref[0, 0]    # (N, N) int32
-    mask = mask_ref[0, 0]  # (N, N) f32, 1.0 = masked
+    rel = rel_ref[0, 0]    # (N_pad, N_pad) int32, values in [0, R)
+    mask = mask_ref[0, 0]  # (N_pad, N_pad) f32, 1.0 = masked
 
     scale = math.sqrt(q.shape[-1] * 3)
     c2c = jnp.dot(q, k.T, preferred_element_type=jnp.float32)
-    c2p = jnp.take_along_axis(
-        jnp.dot(q, lk.T, preferred_element_type=jnp.float32), rel, axis=1
+    c2p = _lane_gather(
+        jnp.dot(q, lk.T, preferred_element_type=jnp.float32), rel
     )
-    p2c = jnp.take_along_axis(
-        jnp.dot(k, lq.T, preferred_element_type=jnp.float32), rel, axis=1
+    p2c = _lane_gather(
+        jnp.dot(k, lq.T, preferred_element_type=jnp.float32), rel
     ).T
     s = (c2c + c2p + p2c) / scale
     s = jnp.where(mask > 0, NEG, s)
     m = jnp.max(s, axis=-1, keepdims=True)
-    e = jnp.exp(s - m)
+    # Padded key columns are dropped from the normalizer so the row sum runs
+    # over the real N only. This matches the XLA composition exactly, also
+    # for fully-masked rows (padded tree positions in ragged batches): there
+    # every real column holds exp(0)=1 and the row comes out uniform 1/N —
+    # the reference's softmax-over-NEG behavior — not 1/N_pad.
+    col_real = jax.lax.broadcasted_iota(jnp.int32, s.shape, 1) < n_real
+    e = jnp.exp(s - m) * col_real.astype(jnp.float32)
     p = e / jnp.sum(e, axis=-1, keepdims=True)
     out_ref[0, 0] = jnp.dot(p, v, preferred_element_type=jnp.float32)
 
@@ -88,22 +130,38 @@ def _fwd_kernel(q_ref, k_ref, v_ref, lq_ref, lk_ref, rel_ref, mask_ref, out_ref)
 def _fwd_call(q, k, v, rel_q, rel_k, rel, mask_f32):
     b, h, n, dk = q.shape
     r = rel_q.shape[1]
-    group = h // 2  # heads [0, group) read the L plane, [group, h) the T plane
-    bh = lambda d: pl.BlockSpec((1, 1, n, d), lambda i, j: (i, j, 0, 0), memory_space=pltpu.VMEM)
-    plane = pl.BlockSpec(
-        (1, 1, n, n), lambda i, j: (i, j // group, 0, 0), memory_space=pltpu.VMEM
+    # Lane-align every gathered extent (see _lane_gather): node axis and
+    # relative-table axis pad to 128-multiples. Padded keys are masked out
+    # (mask=1.0) so real rows are unchanged; padded query rows are sliced
+    # off after the call.
+    n_pad = round_up(n, LANE)
+    r_pad = round_up(r, LANE)
+    q, k, v = (
+        jnp.pad(x, ((0, 0), (0, 0), (0, n_pad - n), (0, 0))) for x in (q, k, v)
     )
-    return pl.pallas_call(
-        _fwd_kernel,
+    rel_q = jnp.pad(rel_q, ((0, 0), (0, r_pad - r), (0, 0)))
+    rel_k = jnp.pad(rel_k, ((0, 0), (0, r_pad - r), (0, 0)))
+    rel = jnp.pad(rel, ((0, 0), (0, 0), (0, n_pad - n), (0, n_pad - n)))
+    mask_f32 = jnp.pad(
+        mask_f32, ((0, 0), (0, 0), (0, n_pad - n), (0, n_pad - n)),
+        constant_values=1.0,
+    )
+    group = h // 2  # heads [0, group) read the L plane, [group, h) the T plane
+    bh = lambda d: pl.BlockSpec((1, 1, n_pad, d), lambda i, j: (i, j, 0, 0), memory_space=pltpu.VMEM)
+    plane = pl.BlockSpec(
+        (1, 1, n_pad, n_pad), lambda i, j: (i, j // group, 0, 0), memory_space=pltpu.VMEM
+    )
+    out = pl.pallas_call(
+        functools.partial(_fwd_kernel, n_real=n),
         grid=(b, h),
         in_specs=[
             bh(dk), bh(dk), bh(dk),
-            pl.BlockSpec((1, r, dk), lambda i, j: (j, 0, 0), memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, r, dk), lambda i, j: (j, 0, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, r_pad, dk), lambda i, j: (j, 0, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, r_pad, dk), lambda i, j: (j, 0, 0), memory_space=pltpu.VMEM),
             plane, plane,
         ],
         out_specs=bh(dk),
-        out_shape=jax.ShapeDtypeStruct((b, h, n, dk), jnp.float32),
+        out_shape=jax.ShapeDtypeStruct((b, h, n_pad, dk), jnp.float32),
         cost_estimate=pl.CostEstimate(
             flops=b * h * (4 * n * n * dk + 4 * n * r * dk + 6 * n * n),
             bytes_accessed=b * h * (3 * n * dk + 2 * n * n) * 4,
@@ -111,6 +169,7 @@ def _fwd_call(q, k, v, rel_q, rel_k, rel, mask_f32):
         ),
         interpret=_interpret(),
     )(q, k, v, rel_q, rel_k, rel, mask_f32)
+    return out[:, :, :n, :]
 
 
 @jax.custom_vjp

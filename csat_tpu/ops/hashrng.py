@@ -21,7 +21,7 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 
-__all__ = ["hash_bits", "bits_to_uniform", "uniform_field", "noise_stride", "TILE"]
+__all__ = ["hash_bits", "bits_to_uniform", "uniform_field", "noise_stride", "round_up", "TILE"]
 
 # node-axis tile edge of the flash kernel; the hash row-stride is the
 # kernel's padded N, so both the in-kernel and materialized streams MUST
@@ -29,9 +29,14 @@ __all__ = ["hash_bits", "bits_to_uniform", "uniform_field", "noise_stride", "TIL
 TILE = 128
 
 
+def round_up(n: int, m: int) -> int:
+    """Smallest multiple of ``m`` that is ≥ ``n``."""
+    return (n + m - 1) // m * m
+
+
 def noise_stride(n: int) -> int:
     """Row stride of the (i, j) hash counter = N padded to the tile edge."""
-    return (n + TILE - 1) // TILE * TILE
+    return round_up(n, TILE)
 
 _C1 = 0x9E3779B9  # golden-ratio mix for the seed
 _C2 = 0x85EBCA6B  # murmur3 constant, mixes batch·head
@@ -60,8 +65,11 @@ def hash_bits(
 def bits_to_uniform(bits: jnp.ndarray) -> jnp.ndarray:
     """uint32 → float32 uniform in [0, 1). The two paths must compare the
     same float against the same threshold, so the conversion is fixed here:
-    the top 24 bits scaled by 2⁻²⁴ (exactly representable in f32)."""
-    return (bits >> jnp.uint32(8)).astype(jnp.float32) * jnp.float32(1.0 / (1 << 24))
+    the top 24 bits scaled by 2⁻²⁴ (exactly representable in f32). The
+    intermediate int32 cast is exact (value < 2²⁴) and needed because
+    Mosaic has no uint32→float32 lowering."""
+    top = (bits >> jnp.uint32(8)).astype(jnp.int32)
+    return top.astype(jnp.float32) * jnp.float32(1.0 / (1 << 24))
 
 
 def uniform_field(

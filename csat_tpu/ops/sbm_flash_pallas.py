@@ -59,7 +59,8 @@ from jax.dtypes import float0
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-from csat_tpu.ops.hashrng import TILE, bits_to_uniform, hash_bits, noise_stride
+from csat_tpu.ops.hashrng import (
+    TILE, bits_to_uniform, hash_bits, noise_stride, round_up)
 from csat_tpu.ops.sbm_pallas import _interpret
 
 # TILE (the q/k tile edge, MXU/lane aligned) lives in hashrng — the hash
@@ -67,10 +68,6 @@ from csat_tpu.ops.sbm_pallas import _interpret
 # materialized XLA path
 KPAD = 128  # cluster axis padded to one lane tile
 BIG = 1e30
-
-
-def _round_up(n: int, m: int) -> int:
-    return (n + m - 1) // m * m
 
 
 def _tile_uniform(seed, bh, iq, ik, stride):
@@ -116,7 +113,7 @@ def _fwd_kernel(
 
     @pl.when((iq == 0) & (ik == 0))
     def _():
-        spars_ref[0, 0] = 0.0
+        spars_ref[0, 0, 0, 0] = 0.0
 
     @pl.when(ik == 0)
     def _():
@@ -124,12 +121,12 @@ def _fwd_kernel(
         l_scr[...] = jnp.zeros_like(l_scr[...])
         acc_scr[...] = jnp.zeros_like(acc_scr[...])
 
-    pad_row = pad_ref[0][None, :]  # (1, TILE) — this k-tile's key padding
+    pad_row = pad_ref[0]  # (1, TILE) — this k-tile's key padding
     a_raw, a_eff = _tile_graph(
         sseed_ref[0], bh, iq, ik, r_ref[0, 0], kh_ref[0, 0], pad_row,
         n_real, stride, floor,
     )
-    spars_ref[0, 0] += jnp.sum(a_raw)
+    spars_ref[0, 0, 0, 0] += jnp.sum(a_raw)
 
     @pl.when(jnp.sum(a_eff) > 0)
     def _():
@@ -155,7 +152,7 @@ def _fwd_kernel(
         live = l > 0.0
         out_ref[0, 0] = jnp.where(live, acc_scr[...] / jnp.maximum(l, 1e-30), 0.0)
         lse = jnp.where(live, m_scr[...] + jnp.log(jnp.maximum(l, 1e-30)), -BIG)
-        lse_ref[0, 0] = lse[:, 0]
+        lse_ref[0, 0] = lse  # (TILE, 1)
 
 
 # ---------------------------------------------------------------------------
@@ -165,17 +162,17 @@ def _fwd_kernel(
 def _bwd_tile(
     live, a_raw, a_eff, q, k, v, g_out, lse, dvec, pad_row, gs, keep, inv_sqrt
 ):
-    """Shared per-tile backward math. Returns (d_expA, d_s, attn_d)."""
+    """Shared per-tile backward math (``lse``/``dvec`` are (TILE, 1)
+    columns). Returns (d_expA, d_s, attn_d)."""
 
     def heavy(_):
         s = jnp.dot(q, k.T, preferred_element_type=jnp.float32) * inv_sqrt
-        lse_col = lse[:, None]
-        finite = lse_col > -BIG / 2
-        e = jnp.where(finite, jnp.exp(s - jnp.where(finite, lse_col, 0.0)), 0.0)
+        finite = lse > -BIG / 2
+        e = jnp.where(finite, jnp.exp(s - jnp.where(finite, lse, 0.0)), 0.0)
         attn = e * a_eff
         d_attn = jnp.dot(g_out, v.T, preferred_element_type=jnp.float32) * keep
-        d_s = attn * (d_attn - dvec[:, None])
-        d_a = e * (d_attn - dvec[:, None]) * (1.0 - pad_row) + gs
+        d_s = attn * (d_attn - dvec)
+        d_a = e * (d_attn - dvec) * (1.0 - pad_row) + gs
         d_exp_a = jnp.clip(a_raw * d_a, -1.0, 1.0)
         return d_exp_a, d_s, attn * keep
 
@@ -201,7 +198,7 @@ def _bwd_q_kernel(
         dq_scr[...] = jnp.zeros_like(dq_scr[...])
         dr_scr[...] = jnp.zeros_like(dr_scr[...])
 
-    pad_row = pad_ref[0][None, :]
+    pad_row = pad_ref[0]  # (1, TILE)
     a_raw, a_eff = _tile_graph(
         sseed_ref[0], bh, iq, ik, r_ref[0, 0], kh_ref[0, 0], pad_row,
         n_real, stride, floor,
@@ -214,7 +211,7 @@ def _bwd_q_kernel(
     live = jnp.sum(a_eff) > 0
     d_exp_a, d_s, _ = _bwd_tile(
         live, a_raw, a_eff, q, k, v, go_ref[0, 0], lse_ref[0, 0],
-        dvec_ref[0, 0], pad_row, gs_ref[0, 0], keep, inv,
+        dvec_ref[0, 0], pad_row, gs_ref[0, 0, 0, 0], keep, inv,
     )
 
     @pl.when(live)
@@ -245,7 +242,7 @@ def _bwd_k_kernel(
         dv_scr[...] = jnp.zeros_like(dv_scr[...])
         dkh_scr[...] = jnp.zeros_like(dkh_scr[...])
 
-    pad_row = pad_ref[0][None, :]
+    pad_row = pad_ref[0]  # (1, TILE)
     a_raw, a_eff = _tile_graph(
         sseed_ref[0], bh, iq, ik, r_ref[0, 0], kh_ref[0, 0], pad_row,
         n_real, stride, floor,
@@ -258,7 +255,7 @@ def _bwd_k_kernel(
     live = jnp.sum(a_eff) > 0
     d_exp_a, d_s, attn_d = _bwd_tile(
         live, a_raw, a_eff, q, k, v, go_ref[0, 0], lse_ref[0, 0],
-        dvec_ref[0, 0], pad_row, gs_ref[0, 0], keep, inv,
+        dvec_ref[0, 0], pad_row, gs_ref[0, 0, 0, 0], keep, inv,
     )
 
     @pl.when(live)
@@ -288,16 +285,22 @@ def _pad_nodes(x, n_pad):
 
 
 def _specs(dh):
+    # Mosaic requires the last two block dims to be (8k, 128k) or equal to
+    # the array dims; vectors therefore carry a trailing unit lane dim
+    # ((B,H,N,1), block (1,1,TILE,1)), the pad mask a unit sublane dim
+    # ((B,1,N), block (1,1,TILE)), and per-(b,h) scalars live in SMEM.
     smem = pl.BlockSpec(memory_space=pltpu.SMEM)
     qspec = lambda g: pl.BlockSpec(
         (1, 1, TILE, dh), lambda b, h, i, j: (b, h, g(i, j), 0), memory_space=pltpu.VMEM)
     cspec = lambda g: pl.BlockSpec(
         (1, 1, TILE, KPAD), lambda b, h, i, j: (b, h, g(i, j), 0), memory_space=pltpu.VMEM)
     vec = lambda g: pl.BlockSpec(
-        (1, 1, TILE), lambda b, h, i, j: (b, h, g(i, j)), memory_space=pltpu.VMEM)
+        (1, 1, TILE, 1), lambda b, h, i, j: (b, h, g(i, j), 0),
+        memory_space=pltpu.VMEM)
     pad = lambda g: pl.BlockSpec(
-        (1, TILE), lambda b, h, i, j: (b, g(i, j)), memory_space=pltpu.VMEM)
-    scal = pl.BlockSpec((1, 1), lambda b, h, i, j: (b, h), memory_space=pltpu.VMEM)
+        (1, 1, TILE), lambda b, h, i, j: (b, 0, g(i, j)), memory_space=pltpu.VMEM)
+    scal = pl.BlockSpec(
+        (1, 1, 1, 1), lambda b, h, i, j: (b, h, 0, 0), memory_space=pltpu.SMEM)
     return smem, qspec, cspec, vec, pad, scal
 
 
@@ -331,8 +334,8 @@ def _fwd_call(q, k, v, r, kh, pad, sseed, dseed, rate, n_real, floor):
         out_specs=[qspec(lambda i, j: i), scal, vec(lambda i, j: i)],
         out_shape=[
             jax.ShapeDtypeStruct((b, h, n_pad, dh), jnp.float32),
-            jax.ShapeDtypeStruct((b, h), jnp.float32),
-            jax.ShapeDtypeStruct((b, h, n_pad), jnp.float32),
+            jax.ShapeDtypeStruct((b, h, 1, 1), jnp.float32),
+            jax.ShapeDtypeStruct((b, h, n_pad, 1), jnp.float32),
         ],
         scratch_shapes=[
             pltpu.VMEM((TILE, 1), jnp.float32),
@@ -415,17 +418,18 @@ def _flash(q, k, v, q_hat, k_hat, s_aff, pad, seeds, rate, floor):
 def _flash_fwd_parts(q, k, v, q_hat, k_hat, s_aff, pad, seeds, rate, floor):
     b, h, n, dh = q.shape
     kk = q_hat.shape[-1]
-    n_pad = _round_up(n, TILE)
+    n_pad = round_up(n, TILE)
     r = jnp.einsum("bhnk,hkj->bhnj", q_hat, s_aff)
     qp, kp, vp = (_pad_nodes(x, n_pad) for x in (q, k, v))
     rp = jnp.pad(r, ((0, 0), (0, 0), (0, n_pad - n), (0, KPAD - kk)))
     khp = jnp.pad(k_hat, ((0, 0), (0, 0), (0, n_pad - n), (0, KPAD - kk)))
     padp = jnp.pad(pad.astype(jnp.float32), ((0, 0), (0, n_pad - n)),
-                   constant_values=1.0)
+                   constant_values=1.0)[:, None, :]  # (B, 1, n_pad)
     sseed = seeds[:1]
     dseed = seeds[1:]
     out_p, spars, lse = _fwd_call(qp, kp, vp, rp, khp, padp, sseed, dseed,
                                   rate, n, floor)
+    spars = spars[:, :, 0, 0]  # (B, H) — SMEM scalars carry unit trailing dims
     return out_p[:, :, :n, :], spars, (out_p, lse, qp, kp, vp, rp, khp, padp)
 
 
@@ -444,8 +448,8 @@ def _flash_vjp_bwd(rate, floor, res, cots):
     n = g_out.shape[2]
     kk = q_hat.shape[-1]
     go_p = _pad_nodes(g_out, n_pad)
-    dvec = jnp.sum(go_p * out_p, axis=-1)  # (B, H, n_pad)
-    gs = g_spars.astype(jnp.float32)  # (B, H) — sparsity-sum cotangent
+    dvec = jnp.sum(go_p * out_p, axis=-1, keepdims=True)  # (B, H, n_pad, 1)
+    gs = g_spars.astype(jnp.float32)[:, :, None, None]  # (B, H, 1, 1)
     dq, dr, dk, dv, dkh = _bwd_call(
         qp, kp, vp, rp, khp, padp, lse, dvec, go_p, gs,
         seeds[:1], seeds[1:], rate, n, floor,

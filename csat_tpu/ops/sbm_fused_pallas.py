@@ -63,9 +63,9 @@ def _fwd_kernel(
 ):
     q, k, v = q_ref[0, 0], k_ref[0, 0], v_ref[0, 0]
     graph, _, attn, _ = _chain(
-        q, k, qh_ref[0, 0], kh_ref[0, 0], s_ref[0], noise_ref[0, 0], pad_ref[...]
+        q, k, qh_ref[0, 0], kh_ref[0, 0], s_ref[0], noise_ref[0, 0], pad_ref[0]
     )
-    spars_ref[0, 0] = jnp.sum(graph)
+    spars_ref[0, 0, 0, 0] = jnp.sum(graph)
     if return_attn:
         attn_ref[0, 0] = attn
     else:
@@ -88,7 +88,7 @@ def _bwd_kernel(
         dq_ref, dk_ref, dv_ref, dqh_ref, dkh_ref, ds_ref = rest
     q, k, v = q_ref[0, 0], k_ref[0, 0], v_ref[0, 0]
     q_hat, k_hat, s = qh_ref[0, 0], kh_ref[0, 0], s_ref[0]
-    graph, p, attn, z = _chain(q, k, q_hat, k_hat, s, noise_ref[0, 0], pad_ref[...])
+    graph, p, attn, z = _chain(q, k, q_hat, k_hat, s, noise_ref[0, 0], pad_ref[0])
     g_out = go_ref[0, 0]
     g_attn_in = ga_ref[0, 0] if has_ga else 0.0
 
@@ -107,7 +107,7 @@ def _bwd_kernel(
     d_w = (d_attn - live * jnp.sum(d_attn * attn, axis=-1, keepdims=True)) / z
 
     # graph cotangent: attention product + sparsity-regularizer scalar
-    d_graph = d_w * p + gs_ref[0, 0]
+    d_graph = d_w * p + gs_ref[0, 0, 0, 0]
     d_p = d_w * graph
     d_sc = p * (d_p - jnp.sum(d_p * p, axis=-1, keepdims=True))
     inv = 1.0 / math.sqrt(q.shape[-1])
@@ -138,8 +138,12 @@ def _specs(b, h, n, dh, kk):
         "hat": bh(kk),
         "s": pl.BlockSpec((1, kk, kk), lambda i, j: (j, 0, 0), memory_space=pltpu.VMEM),
         "nn": bh(n),
-        "pad": pl.BlockSpec((1, n), lambda i, j: (i, 0), memory_space=pltpu.VMEM),
-        "scalar": pl.BlockSpec((1, 1), lambda i, j: (i, j), memory_space=pltpu.VMEM),
+        # Mosaic: last two block dims must be (8k, 128k)-divisible or equal
+        # to the array dims — pad carries a unit sublane dim, per-(b,h)
+        # scalars carry unit trailing dims and live in SMEM.
+        "pad": pl.BlockSpec((1, 1, n), lambda i, j: (i, 0, 0), memory_space=pltpu.VMEM),
+        "scalar": pl.BlockSpec(
+            (1, 1, 1, 1), lambda i, j: (i, j, 0, 0), memory_space=pltpu.SMEM),
     }
 
 
@@ -167,7 +171,7 @@ def _fwd_call(q, k, v, q_hat, k_hat, s, noise, pad, seed_arr, rate, return_attn)
         ],
         out_shape=[
             jax.ShapeDtypeStruct((b, h, n, dh), jnp.float32),
-            jax.ShapeDtypeStruct((b, h), jnp.float32),
+            jax.ShapeDtypeStruct((b, h, 1, 1), jnp.float32),
             jax.ShapeDtypeStruct((b, h, attn_n, attn_n), jnp.float32),
         ],
         cost_estimate=pl.CostEstimate(
@@ -176,7 +180,8 @@ def _fwd_call(q, k, v, q_hat, k_hat, s, noise, pad, seed_arr, rate, return_attn)
             transcendentals=b * h * n * n,
         ),
         interpret=_interpret(),
-    )(seed_arr, q, k, v, q_hat, k_hat, s, noise, pad)
+    )(seed_arr, q, k, v, q_hat, k_hat, s, noise, pad[:, None, :])
+    spars = spars[:, :, 0, 0]  # SMEM scalars carry unit trailing dims
     if not return_attn:
         attn = None
     return out, spars, attn
@@ -200,7 +205,8 @@ def _vjp_bwd(rate, return_attn, res, cots):
         sp["hat"], sp["hat"], sp["s"], sp["nn"], sp["pad"],
         sp["qkv"], sp["scalar"],
     ]
-    inputs = [seed_arr, q, k, v, q_hat, k_hat, s, noise, pad, g_out, g_spars]
+    inputs = [seed_arr, q, k, v, q_hat, k_hat, s, noise, pad[:, None, :],
+              g_out, g_spars[:, :, None, None]]
     if has_ga:
         in_specs.append(sp["nn"])
         inputs.append(g_attn)

@@ -88,7 +88,7 @@ def _fwd_kernel(seed_ref, q_ref, k_ref, v_ref, g_ref, pad_ref, out_ref, attn_ref
     q = q_ref[0, 0]
     k = k_ref[0, 0]
     v = v_ref[0, 0]
-    _, attn, _ = _attn_chain(q, k, g_ref[0, 0], pad_ref[...])
+    _, attn, _ = _attn_chain(q, k, g_ref[0, 0], pad_ref[0])
     attn_ref[0, 0] = attn
     if rate > 0.0:
         pid = pl.program_id(0) * pl.num_programs(1) + pl.program_id(1)
@@ -108,7 +108,7 @@ def _bwd_kernel(
     v = v_ref[0, 0]
     graph = g_ref[0, 0]
     g_out = go_ref[0, 0]
-    p, attn, z = _attn_chain(q, k, graph, pad_ref[...])
+    p, attn, z = _attn_chain(q, k, graph, pad_ref[0])
 
     if rate > 0.0:
         pid = pl.program_id(0) * pl.num_programs(1) + pl.program_id(1)
@@ -139,7 +139,9 @@ def _bh_spec(n: int, d: int):
 
 
 def _pad_spec(n: int):
-    return pl.BlockSpec((1, n), lambda i, j: (i, 0), memory_space=pltpu.VMEM)
+    # (B, 1, N) with a unit sublane dim: Mosaic requires the last two block
+    # dims to be (8k, 128k)-divisible or equal to the array dims.
+    return pl.BlockSpec((1, 1, n), lambda i, j: (i, 0, 0), memory_space=pltpu.VMEM)
 
 
 def _seed_spec():
@@ -174,7 +176,7 @@ def _fwd_call(q, k, v, graph, pad, seed_arr, rate):
             transcendentals=b * h * n * n,
         ),
         interpret=_interpret(),
-    )(seed_arr, q, k, v, graph, pad)
+    )(seed_arr, q, k, v, graph, pad[:, None, :])
     return out, attn
 
 
@@ -212,7 +214,7 @@ def _vjp_bwd(rate, res, cotangents):
             transcendentals=b * h * n * n,
         ),
         interpret=_interpret(),
-    )(seed_arr, q, k, v, graph, pad, g_out, g_attn)
+    )(seed_arr, q, k, v, graph, pad[:, None, :], g_out, g_attn)
     d_pad = jnp.zeros_like(pad)
     d_seed = np.zeros(seed_arr.shape, dtype=float0)
     return dq, dk, dv, dg, d_pad, d_seed

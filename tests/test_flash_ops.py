@@ -17,7 +17,8 @@ import pytest
 from csat_tpu.models.sbm import l1_normalize
 from csat_tpu.models.ste import sample_graph
 from csat_tpu.ops.hashrng import bits_to_uniform, hash_bits, uniform_field
-from csat_tpu.ops.sbm_flash_pallas import TILE, _round_up, sbm_attention_flash
+from csat_tpu.ops.hashrng import round_up
+from csat_tpu.ops.sbm_flash_pallas import TILE, sbm_attention_flash
 
 
 def _inputs(b=2, h=2, n=150, dh=32, kk=5, seed=0):
@@ -37,7 +38,7 @@ def _xla_mirror(q, k, v, q_hat, k_hat, s_aff, pad, sample_seed,
                 rate=0.0, drop_seed=None):
     """Reference composition with the materialized hash-noise field."""
     b, h, n, dh = q.shape
-    noise = uniform_field(sample_seed, b, h, n, n, _round_up(n, TILE))
+    noise = uniform_field(sample_seed, b, h, n, n, round_up(n, TILE))
     exp_a = jnp.einsum("bhnk,hkj,bhmj->bhnm", q_hat, s_aff, k_hat)
     graph = sample_graph(exp_a, noise)
     mask = pad[:, None, None, :].astype(bool)
@@ -51,7 +52,7 @@ def _xla_mirror(q, k, v, q_hat, k_hat, s_aff, pad, sample_seed,
             jax.lax.broadcasted_iota(jnp.uint32, (b, h, 1, 1), 0) * jnp.uint32(h)
             + jax.lax.broadcasted_iota(jnp.uint32, (b, h, 1, 1), 1)
         )
-        u = bits_to_uniform(hash_bits(drop_seed, bh, rows, cols, _round_up(n, TILE)))
+        u = bits_to_uniform(hash_bits(drop_seed, bh, rows, cols, round_up(n, TILE)))
         attn = attn * jnp.where(u >= rate, 1.0 / (1.0 - rate), 0.0)
     out = jnp.einsum("bhnm,bhmd->bhnd", attn, v)
     graph_sums = jnp.sum(graph, axis=(2, 3))

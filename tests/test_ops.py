@@ -134,6 +134,31 @@ def test_cse_pallas_matches_xla():
         np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=2e-4, err_msg=name)
 
 
+def test_cse_pallas_fully_masked_rows_match_xla():
+    """Ragged batches mask every key of a padded query row; the reference's
+    softmax-over-NEG then yields a uniform 1/N row. The kernel lane-pads N
+    internally (Mosaic gather alignment) and must still normalize over the
+    real N only — a r3 review found the padded columns leaking into the
+    normalizer (rows came out scaled by N/N_pad)."""
+    from csat_tpu.ops.cse_pallas import _xla_forward, disentangled_attention_pallas
+
+    B2, H2, N2, DK, R = 1, 2, 9, 8, 12
+    ks = jax.random.split(jax.random.key(7), 6)
+    q = jax.random.normal(ks[0], (B2, H2, N2, DK), jnp.float32)
+    k = jax.random.normal(ks[1], (B2, H2, N2, DK), jnp.float32)
+    v = jax.random.normal(ks[2], (B2, H2, N2, DK), jnp.float32)
+    lq = jax.random.normal(ks[3], (H2, R, DK), jnp.float32)
+    lk = jax.random.normal(ks[4], (H2, R, DK), jnp.float32)
+    rel = jax.random.randint(ks[5], (B2, 2, N2, N2), 0, R, dtype=jnp.int32)
+    mask = np.zeros((B2, 2, N2, N2), bool)
+    mask[:, :, -3:, :] = True  # last rows fully masked, as past num_node
+    mask = jnp.asarray(mask)
+
+    out_p = disentangled_attention_pallas(q, k, v, lq, lk, rel, mask)
+    out_x = _xla_forward(q, k, v, lq, lk, rel, mask.astype(jnp.float32))
+    np.testing.assert_allclose(np.asarray(out_p), np.asarray(out_x), atol=1e-5)
+
+
 def test_sbm_pallas_dropout_fwd_bwd_consistent():
     """out is linear in v; with in-kernel dropout the identity
     <f(v'), g> == <v', df/dv(g)> holds ONLY if forward and backward
