@@ -40,6 +40,12 @@ def main() -> None:
                    help="continue from the newest checkpoint in the output dir")
     p.add_argument("--platform", default="cpu",
                    help="jax platform; the bounded-budget run is CPU-sized")
+    p.add_argument("--backend", default="", choices=["", "xla", "pallas"],
+                   help="attention backend override (default: config's)")
+    p.add_argument("--full_dims", action="store_true",
+                   help="train at the reference config's full dims "
+                        "(512-wide, 4+4 layers — TPU-sized) instead of the "
+                        "CPU-budget 128-wide 2+2 stack")
     args = p.parse_args()
 
     os.environ["JAX_PLATFORMS"] = args.platform
@@ -52,10 +58,7 @@ def main() -> None:
     from csat_tpu.train import Trainer, run_test
 
     name = "python_full_att" if args.variant == "full_att" else "python"
-    cfg = get_config(
-        name,
-        data_dir=args.data_dir,
-        task_name=f"real_stdlib_{args.variant}",
+    dims = {} if args.full_dims else dict(
         pe_dim=64,
         pegen_dim=128,
         sbm_enc_dim=128,
@@ -66,12 +69,20 @@ def main() -> None:
         clusters=(8, 8),
         dim_feed_forward=512,
         max_tgt_len=30,
+    )
+    if args.backend:
+        dims["backend"] = args.backend
+    cfg = get_config(
+        name,
+        data_dir=args.data_dir,
+        task_name=f"real_stdlib_{args.variant}",
         batch_size=args.batch_size,
         num_epochs=args.epochs,
         learning_rate=args.learning_rate,
         val_interval=args.val_interval,
         save_interval=args.save_interval,
         output_dir=args.out,
+        **dims,
     )
 
     out_dir = os.path.join(args.out, cfg.project_name, cfg.task_name)
