@@ -166,7 +166,11 @@ def _serve(specs_csv: str, soft_budget_s: float) -> None:
 
     for i, spec in enumerate(specs):
         left = soft_budget_s - (time.monotonic() - t0)
-        if i > 0 and left < 60:
+        # the floor must cover a worst-case compile: starting a device spec
+        # with less leaves it to the parent's mid-compile SIGKILL, which can
+        # wedge the chip claim (see module docstring)
+        floor = 120 if spec.split(":")[2] == "cpu" else 420
+        if i > 0 and left < floor:
             emit({"phase": "budget", "skipped": specs[i:], "left_s": round(left)})
             break
         emit({"phase": "start", "spec": spec, "left_s": round(left)})
@@ -314,7 +318,10 @@ def main() -> None:
     done = {r["spec"] for r in results}
     missing = [s for s in specs if s not in done and s not in errored]
     missing.sort(key=lambda s: s in started)
-    budget_cut = any(e and e.startswith("timeout") for e in serve_errs) or any(
+    # retry-worthy: budget cuts (timeout kill / soft skip) AND child crashes
+    # (segfault, backend abort → rc!=0) — both leave untried specs behind;
+    # only deterministic per-spec Python errors are final
+    budget_cut = any(e for e in serve_errs) or any(
         p.get("phase") == "budget" for p in phases)
     if missing and budget_cut:
         for grp in _groups(missing):

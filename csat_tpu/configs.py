@@ -89,6 +89,14 @@ class Config:
     #             lever (the XLA backend materializes the same stream for
     #             differential testing).
     noise_mode: str = "shared"
+    # sequence-parallel attention implementation on a `seq`-sharded mesh:
+    # "allgather" — XLA's automatic collectives gather full K/V per device;
+    # "ring"      — ring attention (csat_tpu/parallel/ring.py): K/V blocks
+    #               rotate neighbor-to-neighbor over ICI with flash-style
+    #               streaming accumulation; requires noise_mode="counter"
+    #               (the Bernoulli stream must be computable per-block from
+    #               global indices). No-op outside a seq>1 mesh.
+    seq_impl: str = "allgather"
     param_dtype: str = "float32"
     compute_dtype: str = "float32"  # "bfloat16" for MXU-friendly training
     mesh_shape: Tuple[Tuple[str, int], ...] = (("data", 1), ("model", 1))
@@ -125,17 +133,26 @@ class Config:
         ), self.use_pegen
         assert self.backend in ("xla", "pallas"), self.backend
         assert self.noise_mode in ("shared", "counter"), self.noise_mode
+        assert self.seq_impl in ("allgather", "ring"), self.seq_impl
+        if self.seq_impl == "ring" and self.noise_mode != "counter":
+            raise ValueError(
+                "seq_impl='ring' requires noise_mode='counter': every device "
+                "must be able to regenerate any (q, k) block's Bernoulli "
+                "draws from global indices (csat_tpu/parallel/ring.py)"
+            )
         if self.backend == "pallas":
             for name, size in self.mesh_shape:
                 if name == "seq" and size != 1:
                     # the pallas kernels hold whole q/k-tiles per program and
-                    # have no cross-shard (ring) exchange; sequence-sharded
-                    # long-AST configs must use the XLA backend, whose
-                    # einsums shard via compiler-inserted collectives
+                    # have no cross-shard exchange — and the aux-collecting
+                    # eval/probe path dispatches to them even under
+                    # seq_impl="ring". Sequence-sharded configs use
+                    # backend="xla" (automatic collectives), optionally with
+                    # seq_impl="ring" for the explicit ppermute ring.
                     raise ValueError(
                         "backend='pallas' does not support a sharded 'seq' "
-                        "mesh axis; use backend='xla' for sequence-parallel "
-                        "configs"
+                        "mesh axis; use backend='xla' (optionally "
+                        "seq_impl='ring') for sequence-parallel configs"
                     )
             import importlib.util
 

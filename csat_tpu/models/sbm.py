@@ -76,6 +76,7 @@ class SBMAttention(nn.Module):
     attention_dropout: float
     backend: str = "xla"
     noise_mode: str = "shared"  # "shared" | "counter" (see configs.Config)
+    seq_impl: str = "allgather"  # "allgather" | "ring" (see configs.Config)
 
     @nn.compact
     def __call__(
@@ -121,6 +122,18 @@ class SBMAttention(nn.Module):
             from csat_tpu.ops.hashrng import noise_stride
 
             sample_seed = draw_seed("sample")
+            if self.seq_impl == "ring" and not need_aux:
+                from csat_tpu.parallel.ring import ring_active, ring_sbm_attention
+
+                if ring_active():
+                    # sequence-parallel ring attention: K/V blocks rotate
+                    # over the seq mesh axis via ppermute; the counter
+                    # stream reproduces the exact same sampled graph
+                    out, graph_sums = ring_sbm_attention(
+                        q, k, v, q_hat, k_hat, s, key_pad, sample_seed,
+                        rate, draw_seed("dropout") if use_dropout else None,
+                    )
+                    return out, head_sparsity(graph_sums), None, None
             if self.backend == "pallas" and not need_aux:
                 from csat_tpu.ops.sbm_flash_pallas import sbm_attention_flash
 
@@ -214,6 +227,7 @@ class SBMBlock(nn.Module):
                 cfg.attention_dropout,
                 backend=cfg.backend,
                 noise_mode=cfg.noise_mode,
+                seq_impl=cfg.seq_impl,
             )(q, k, v, key_pad, deterministic, need_aux)
         attn_out = dense(d, self.dtype, name="wo")(merge_heads(attn_out).astype(self.dtype))
         x = x + nn.Dropout(cfg.dropout)(attn_out, deterministic=deterministic)

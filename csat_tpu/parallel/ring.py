@@ -1,0 +1,202 @@
+"""Ring attention for sequence-parallel SBM sparse attention.
+
+The ``seq``-sharded long-AST path (SURVEY §5; the reference hard-caps
+sequences at 150 nodes and has no long-sequence story) normally relies on
+XLA's automatic collectives: the attention contractions all-gather the full
+K/V onto every device. This module adds the communication-optimal
+alternative — **ring attention** (Liu et al., blockwise parallel
+transformers): each device keeps only its own N/P node block of K/V and the
+blocks rotate around the ``seq`` mesh axis via ``ppermute`` while each
+device accumulates flash-style streaming softmax statistics over one
+incoming block at a time. Peak activation memory per device drops from
+O(N·d) (gathered K/V) + the XLA path's O(N²) score rows to O(N²/P²) per
+step, and the transfers ride the ICI ring neighbor-to-neighbor instead of
+an all-to-all gather.
+
+Why this composes exactly with the SBM sampler: the Bernoulli draw for
+every (i, j) attention pair comes from the counter-based hash stream
+(:mod:`csat_tpu.ops.hashrng`, ``noise_mode="counter"``), which is a pure
+function of the **global** (batch·head, row, col) indices — any device can
+generate any block's noise locally, so the sampled graph is bit-identical
+to the single-device XLA mirror and to the flash Pallas kernel, with no
+(B, H, N, N) tensor and no cross-device RNG state anywhere.
+
+Semantics match ``csat_tpu/ops/sbm_flash_pallas.py`` (same softmax-
+cancellation formulation, same documented dead-row delta vs the reference's
+1e-12 L1-renorm guard; the straight-through estimator enters through
+:func:`csat_tpu.models.ste.sample_graph`'s ``custom_vjp``, so the backward
+is the reference STE, ref ``STE.py:17-19``). Gradients flow through
+``lax.scan`` + ``ppermute`` by plain autodiff (the transpose of a ring
+rotation is the reverse rotation — XLA schedules the backward ring
+automatically); the per-step body is ``jax.checkpoint``-ed so residuals
+stay O(N²/P²).
+
+Select with ``Config.seq_impl = "ring"`` (requires ``noise_mode="counter"``;
+validated in :mod:`csat_tpu.configs`). Outside a ``seq>1`` mesh the model
+falls back to the regular path (:func:`ring_active` is False).
+"""
+
+from __future__ import annotations
+
+import math
+from functools import partial
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from csat_tpu.models.ste import sample_graph
+from csat_tpu.ops.hashrng import bits_to_uniform, hash_bits, noise_stride
+
+BIG = 1e30
+
+__all__ = ["ring_active", "ring_sbm_attention"]
+
+
+def _mesh_axis_size(mesh, name: str) -> int:
+    if mesh is None or name not in mesh.axis_names:
+        return 1
+    return mesh.shape[name]
+
+
+def ring_active() -> bool:
+    """True when the ambient mesh (``jax.sharding.set_mesh``) has a ``seq``
+    axis of size > 1 — the only regime where the ring path differs from the
+    plain computation."""
+    mesh = jax.sharding.get_abstract_mesh()
+    return _mesh_axis_size(mesh, "seq") > 1
+
+
+def _block_uniform(seed, bh, row0, col0, nl, nk, stride):
+    """Uniform draws for the (local-q, current-k) block from the global
+    counter stream — identical bits to ``hashrng.uniform_field`` and the
+    in-kernel generation of the flash Pallas kernel."""
+    rows = row0 + jax.lax.broadcasted_iota(jnp.uint32, (1, 1, nl, nk), 2)
+    cols = col0 + jax.lax.broadcasted_iota(jnp.uint32, (1, 1, nl, nk), 3)
+    return bits_to_uniform(hash_bits(seed, bh, rows, cols, stride))
+
+
+def _ring_body(
+    q, r, sseed, dseed, bh, row0, nl, p, stride, rate, scale, carry, src,
+):
+    """One ring step: consume the currently-held K/V block, then rotate."""
+    k_cur, v_cur, kh_cur, pad_cur, m, l, acc, spars = carry
+    col0 = src * nl
+
+    u = _block_uniform(sseed, bh, row0, col0, nl, nl, stride)
+    exp_a = jnp.einsum("bhnj,bhmj->bhnm", r, kh_cur)
+    a_raw = sample_graph(exp_a, u)  # STE custom_vjp (ref STE.py)
+    a_eff = a_raw * (1.0 - pad_cur[:, None, None, :])
+
+    s_blk = jnp.einsum("bhnd,bhmd->bhnm", q, k_cur) * scale
+    s_blk = jnp.where(a_eff > 0, s_blk, -BIG)
+    m_new = jnp.maximum(m, jnp.max(s_blk, axis=-1, keepdims=True))
+    alpha = jnp.exp(m - m_new)
+    w = jnp.exp(s_blk - m_new) * a_eff
+    l = l * alpha + jnp.sum(w, axis=-1, keepdims=True)
+    if rate > 0.0:
+        ud = _block_uniform(dseed, bh, row0, col0, nl, nl, stride)
+        w = w * jnp.where(ud >= rate, 1.0 / (1.0 - rate), 0.0)
+    acc = acc * alpha + jnp.einsum("bhnm,bhmd->bhnd", w, v_cur)
+    spars = spars + jnp.sum(a_raw, axis=(2, 3))
+
+    # rotate K/V/K̂/pad one hop around the seq ring (the final rotation
+    # restores the original layout; its cost is one extra neighbor hop)
+    perm = [(i, (i + 1) % p) for i in range(p)]
+    k_cur, v_cur, kh_cur, pad_cur = (
+        jax.lax.ppermute(t, "seq", perm) for t in (k_cur, v_cur, kh_cur, pad_cur)
+    )
+    return (k_cur, v_cur, kh_cur, pad_cur, m_new, l, acc, spars), None
+
+
+def _ring_local(q, k, v, q_hat, k_hat, s_aff, pad, seeds, *, rate, n, h_total,
+                b_shards, h_shards):
+    """Per-shard ring computation (runs inside ``shard_map``)."""
+    b_loc, h_loc, nl, dh = q.shape
+    p = jax.lax.axis_size("seq")
+    my = jax.lax.axis_index("seq")
+    row0 = my * nl
+    stride = noise_stride(n)
+    scale = 1.0 / math.sqrt(dh)
+
+    # global (batch·head) hash index for this shard's rows
+    b0 = (jax.lax.axis_index("data") if b_shards > 1 else 0) * b_loc
+    h0 = (jax.lax.axis_index("model") if h_shards > 1 else 0) * h_loc
+    b_ix = b0 + jax.lax.broadcasted_iota(jnp.uint32, (b_loc, h_loc, 1, 1), 0)
+    h_ix = h0 + jax.lax.broadcasted_iota(jnp.uint32, (b_loc, h_loc, 1, 1), 1)
+    bh = b_ix * jnp.uint32(h_total) + h_ix
+
+    r = jnp.einsum("bhnk,hkj->bhnj", q_hat, s_aff)
+    m = jnp.full((b_loc, h_loc, nl, 1), -BIG, jnp.float32)
+    l = jnp.zeros((b_loc, h_loc, nl, 1), jnp.float32)
+    acc = jnp.zeros((b_loc, h_loc, nl, dh), jnp.float32)
+    spars = jnp.zeros((b_loc, h_loc), jnp.float32)
+
+    body = partial(
+        _ring_body, q, r, seeds[0], seeds[1], bh, row0, nl, p,
+        stride, rate, scale,
+    )
+    # blocks arrive in source order my, my-1, …  (rotation sends +1 around
+    # the ring, so after t hops we hold shard (my - t) mod p's block)
+    srcs = (my - jnp.arange(p)) % p
+    carry = (k, v, k_hat, pad, m, l, acc, spars)
+    carry, _ = jax.lax.scan(jax.checkpoint(body), carry, srcs)
+    _, _, _, _, m, l, acc, spars = carry
+
+    live = l > 0.0
+    out = jnp.where(live, acc / jnp.maximum(l, 1e-30), 0.0)
+    graph_sums = jax.lax.psum(spars, "seq")  # ΣA over all (q, k) blocks
+    return out, graph_sums
+
+
+def ring_sbm_attention(
+    q: jnp.ndarray,        # (B, H, N, dh) fp32, node axis seq-sharded
+    k: jnp.ndarray,
+    v: jnp.ndarray,
+    q_hat: jnp.ndarray,    # (B, H, N, K) fp32 — soft cluster memberships
+    k_hat: jnp.ndarray,
+    s_aff: jnp.ndarray,    # (H, K, K) fp32 — cluster affinity
+    key_pad: jnp.ndarray,  # (B, N), truthy = padded
+    sample_seed: jnp.ndarray,
+    dropout_rate: float = 0.0,
+    dropout_seed: Optional[jnp.ndarray] = None,
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Ring-parallel SBM attention over the ambient mesh's ``seq`` axis.
+
+    Returns ``(out, graph_sums)`` with the same contract as
+    ``sbm_attention_flash`` — ``graph_sums`` is ΣA per (batch, head).
+    """
+    mesh = jax.sharding.get_abstract_mesh()
+    p = _mesh_axis_size(mesh, "seq")
+    b, h, n, dh = q.shape
+    if n % p != 0:
+        raise ValueError(f"ring attention needs N ({n}) divisible by the seq"
+                         f" axis ({p})")
+    b_shards = _mesh_axis_size(mesh, "data")
+    h_shards = _mesh_axis_size(mesh, "model")
+    if dropout_seed is None:
+        dropout_seed = jnp.zeros((), dtype=jnp.int32)
+    seeds = jnp.stack([
+        jnp.asarray(sample_seed, jnp.int32).reshape(()),
+        jnp.asarray(dropout_seed, jnp.int32).reshape(()),
+    ])
+
+    d = "data" if b_shards > 1 else None
+    mdl = "model" if h_shards > 1 else None
+    qspec = P(d, mdl, "seq", None)
+    hatspec = P(d, mdl, "seq", None)
+    padspec = P(d, "seq")
+    fn = partial(
+        _ring_local, rate=float(dropout_rate), n=n, h_total=h,
+        b_shards=b_shards, h_shards=h_shards,
+    )
+    out, graph_sums = jax.shard_map(
+        fn,
+        mesh=mesh,
+        in_specs=(qspec, qspec, qspec, hatspec, hatspec, P(mdl, None, None),
+                  padspec, P()),
+        out_specs=(qspec, P(d, mdl)),
+        check_vma=False,
+    )(q, k, v, q_hat, k_hat, s_aff, key_pad.astype(jnp.float32), seeds)
+    return out, graph_sums
