@@ -134,7 +134,10 @@ class Config:
         assert self.backend in ("xla", "pallas"), self.backend
         assert self.noise_mode in ("shared", "counter"), self.noise_mode
         assert self.seq_impl in ("allgather", "ring"), self.seq_impl
-        if self.seq_impl == "ring" and self.noise_mode != "counter":
+        if (self.seq_impl == "ring" and self.noise_mode != "counter"
+                and not self.full_att):
+            # full_att models never Bernoulli-sample, so ring works there
+            # regardless of noise_mode
             raise ValueError(
                 "seq_impl='ring' requires noise_mode='counter': every device "
                 "must be able to regenerate any (q, k) block's Bernoulli "

@@ -185,9 +185,23 @@ class FullAttention(nn.Module):
 
     head_dim: int
     attention_dropout: float
+    seq_impl: str = "allgather"
 
     @nn.compact
-    def __call__(self, q, k, v, key_pad, deterministic: bool = True):
+    def __call__(self, q, k, v, key_pad, deterministic: bool = True,
+                 need_aux: bool = False):
+        if self.seq_impl == "ring" and not need_aux:
+            from csat_tpu.parallel.ring import ring_active, ring_full_attention
+
+            if ring_active():
+                rate = self.attention_dropout if not deterministic else 0.0
+                dseed = None
+                if rate > 0.0:
+                    dseed = jax.random.randint(
+                        self.make_rng("dropout"), (), 0,
+                        jnp.iinfo(jnp.int32).max, dtype=jnp.int32)
+                out = ring_full_attention(q, k, v, key_pad, rate, dseed)
+                return out, None, None, None
         mask = key_pad[:, None, None, :].astype(bool)
         dot = jnp.einsum("bhnd,bhmd->bhnm", q, k) / math.sqrt(self.head_dim)
         dot = jnp.where(mask, -jnp.inf, dot)
@@ -217,8 +231,8 @@ class SBMBlock(nn.Module):
         q, k, v = (t.astype(jnp.float32) for t in (q, k, v))
         if cfg.full_att:
             attn_out, sparsity, graph, attn = FullAttention(
-                cfg.head_dim, cfg.attention_dropout
-            )(q, k, v, key_pad, deterministic)
+                cfg.head_dim, cfg.attention_dropout, seq_impl=cfg.seq_impl
+            )(q, k, v, key_pad, deterministic, need_aux)
         else:
             attn_out, sparsity, graph, attn = SBMAttention(
                 cfg.num_heads,

@@ -51,7 +51,7 @@ from csat_tpu.ops.hashrng import bits_to_uniform, hash_bits, noise_stride
 
 BIG = 1e30
 
-__all__ = ["ring_active", "ring_sbm_attention"]
+__all__ = ["ring_active", "ring_full_attention", "ring_sbm_attention"]
 
 
 def _mesh_axis_size(mesh, name: str) -> int:
@@ -80,14 +80,24 @@ def _block_uniform(seed, bh, row0, col0, nl, nk, stride):
 def _ring_body(
     q, r, sseed, dseed, bh, row0, nl, p, stride, rate, scale, carry, src,
 ):
-    """One ring step: consume the currently-held K/V block, then rotate."""
-    k_cur, v_cur, kh_cur, pad_cur, m, l, acc, spars = carry
+    """One ring step: consume the currently-held K/V block, then rotate.
+
+    ``r is None`` selects the dense (FullAttention) variant: no Bernoulli
+    sampling, the live set is simply the unpadded keys."""
+    blocks, m, l, acc, spars = carry
     col0 = src * nl
 
-    u = _block_uniform(sseed, bh, row0, col0, nl, nl, stride)
-    exp_a = jnp.einsum("bhnj,bhmj->bhnm", r, kh_cur)
-    a_raw = sample_graph(exp_a, u)  # STE custom_vjp (ref STE.py)
-    a_eff = a_raw * (1.0 - pad_cur[:, None, None, :])
+    if r is None:
+        k_cur, v_cur, pad_cur = blocks
+        a_raw = None
+        a_eff = jnp.broadcast_to(
+            1.0 - pad_cur[:, None, None, :], (*q.shape[:3], nl))
+    else:
+        k_cur, v_cur, kh_cur, pad_cur = blocks
+        u = _block_uniform(sseed, bh, row0, col0, nl, nl, stride)
+        exp_a = jnp.einsum("bhnj,bhmj->bhnm", r, kh_cur)
+        a_raw = sample_graph(exp_a, u)  # STE custom_vjp (ref STE.py)
+        a_eff = a_raw * (1.0 - pad_cur[:, None, None, :])
 
     s_blk = jnp.einsum("bhnd,bhmd->bhnm", q, k_cur) * scale
     s_blk = jnp.where(a_eff > 0, s_blk, -BIG)
@@ -99,20 +109,21 @@ def _ring_body(
         ud = _block_uniform(dseed, bh, row0, col0, nl, nl, stride)
         w = w * jnp.where(ud >= rate, 1.0 / (1.0 - rate), 0.0)
     acc = acc * alpha + jnp.einsum("bhnm,bhmd->bhnd", w, v_cur)
-    spars = spars + jnp.sum(a_raw, axis=(2, 3))
+    if a_raw is not None:
+        spars = spars + jnp.sum(a_raw, axis=(2, 3))
 
-    # rotate K/V/K̂/pad one hop around the seq ring (the final rotation
+    # rotate K/V/(K̂)/pad one hop around the seq ring (the final rotation
     # restores the original layout; its cost is one extra neighbor hop)
     perm = [(i, (i + 1) % p) for i in range(p)]
-    k_cur, v_cur, kh_cur, pad_cur = (
-        jax.lax.ppermute(t, "seq", perm) for t in (k_cur, v_cur, kh_cur, pad_cur)
-    )
-    return (k_cur, v_cur, kh_cur, pad_cur, m_new, l, acc, spars), None
+    blocks = tuple(jax.lax.ppermute(t, "seq", perm) for t in blocks)
+    return (blocks, m_new, l, acc, spars), None
 
 
 def _ring_local(q, k, v, q_hat, k_hat, s_aff, pad, seeds, *, rate, n, h_total,
                 b_shards, h_shards):
-    """Per-shard ring computation (runs inside ``shard_map``)."""
+    """Per-shard ring computation (runs inside ``shard_map``).
+
+    ``q_hat is None`` selects the dense (FullAttention) variant."""
     b_loc, h_loc, nl, dh = q.shape
     p = jax.lax.axis_size("seq")
     my = jax.lax.axis_index("seq")
@@ -127,7 +138,8 @@ def _ring_local(q, k, v, q_hat, k_hat, s_aff, pad, seeds, *, rate, n, h_total,
     h_ix = h0 + jax.lax.broadcasted_iota(jnp.uint32, (b_loc, h_loc, 1, 1), 1)
     bh = b_ix * jnp.uint32(h_total) + h_ix
 
-    r = jnp.einsum("bhnk,hkj->bhnj", q_hat, s_aff)
+    r = (None if q_hat is None
+         else jnp.einsum("bhnk,hkj->bhnj", q_hat, s_aff))
     m = jnp.full((b_loc, h_loc, nl, 1), -BIG, jnp.float32)
     l = jnp.zeros((b_loc, h_loc, nl, 1), jnp.float32)
     acc = jnp.zeros((b_loc, h_loc, nl, dh), jnp.float32)
@@ -140,14 +152,47 @@ def _ring_local(q, k, v, q_hat, k_hat, s_aff, pad, seeds, *, rate, n, h_total,
     # blocks arrive in source order my, my-1, …  (rotation sends +1 around
     # the ring, so after t hops we hold shard (my - t) mod p's block)
     srcs = (my - jnp.arange(p)) % p
-    carry = (k, v, k_hat, pad, m, l, acc, spars)
+    blocks = (k, v, pad) if q_hat is None else (k, v, k_hat, pad)
+    carry = (blocks, m, l, acc, spars)
     carry, _ = jax.lax.scan(jax.checkpoint(body), carry, srcs)
-    _, _, _, _, m, l, acc, spars = carry
+    _, m, l, acc, spars = carry
 
     live = l > 0.0
     out = jnp.where(live, acc / jnp.maximum(l, 1e-30), 0.0)
+    if q_hat is None:
+        return out  # dense variant: no sampled graph, no sparsity collective
     graph_sums = jax.lax.psum(spars, "seq")  # ΣA over all (q, k) blocks
     return out, graph_sums
+
+
+def _ring_setup(n: int, h: int, sample_seed, dropout_seed, rate):
+    """Shared shard_map plumbing for both ring variants: mesh-axis probing,
+    divisibility check, seed stacking, spec construction, local-fn kwargs."""
+    mesh = jax.sharding.get_abstract_mesh()
+    p = _mesh_axis_size(mesh, "seq")
+    if n % p != 0:
+        raise ValueError(f"ring attention needs N ({n}) divisible by the seq"
+                         f" axis ({p})")
+    b_shards = _mesh_axis_size(mesh, "data")
+    h_shards = _mesh_axis_size(mesh, "model")
+    if dropout_seed is None:
+        dropout_seed = jnp.zeros((), dtype=jnp.int32)
+    seeds = jnp.stack([
+        jnp.asarray(sample_seed, jnp.int32).reshape(()),
+        jnp.asarray(dropout_seed, jnp.int32).reshape(()),
+    ])
+    d = "data" if b_shards > 1 else None
+    mdl = "model" if h_shards > 1 else None
+    specs = {
+        "q": P(d, mdl, "seq", None),
+        "pad": P(d, "seq"),
+        "rep": P(),
+        "bh": P(d, mdl),
+        "aff": P(mdl, None, None),
+    }
+    kwargs = dict(rate=float(rate), n=n, h_total=h,
+                  b_shards=b_shards, h_shards=h_shards)
+    return mesh, seeds, specs, kwargs
 
 
 def ring_sbm_attention(
@@ -167,36 +212,47 @@ def ring_sbm_attention(
     Returns ``(out, graph_sums)`` with the same contract as
     ``sbm_attention_flash`` — ``graph_sums`` is ΣA per (batch, head).
     """
-    mesh = jax.sharding.get_abstract_mesh()
-    p = _mesh_axis_size(mesh, "seq")
-    b, h, n, dh = q.shape
-    if n % p != 0:
-        raise ValueError(f"ring attention needs N ({n}) divisible by the seq"
-                         f" axis ({p})")
-    b_shards = _mesh_axis_size(mesh, "data")
-    h_shards = _mesh_axis_size(mesh, "model")
-    if dropout_seed is None:
-        dropout_seed = jnp.zeros((), dtype=jnp.int32)
-    seeds = jnp.stack([
-        jnp.asarray(sample_seed, jnp.int32).reshape(()),
-        jnp.asarray(dropout_seed, jnp.int32).reshape(()),
-    ])
-
-    d = "data" if b_shards > 1 else None
-    mdl = "model" if h_shards > 1 else None
-    qspec = P(d, mdl, "seq", None)
-    hatspec = P(d, mdl, "seq", None)
-    padspec = P(d, "seq")
-    fn = partial(
-        _ring_local, rate=float(dropout_rate), n=n, h_total=h,
-        b_shards=b_shards, h_shards=h_shards,
-    )
+    n, h = q.shape[2], q.shape[1]
+    mesh, seeds, sp, kwargs = _ring_setup(
+        n, h, sample_seed, dropout_seed, dropout_rate)
     out, graph_sums = jax.shard_map(
-        fn,
+        partial(_ring_local, **kwargs),
         mesh=mesh,
-        in_specs=(qspec, qspec, qspec, hatspec, hatspec, P(mdl, None, None),
-                  padspec, P()),
-        out_specs=(qspec, P(d, mdl)),
+        in_specs=(sp["q"], sp["q"], sp["q"], sp["q"], sp["q"], sp["aff"],
+                  sp["pad"], sp["rep"]),
+        out_specs=(sp["q"], sp["bh"]),
         check_vma=False,
     )(q, k, v, q_hat, k_hat, s_aff, key_pad.astype(jnp.float32), seeds)
     return out, graph_sums
+
+
+def _full_local(q, k, v, pad, seeds, **kw):
+    return _ring_local(q, k, v, None, None, None, pad, seeds, **kw)
+
+
+def ring_full_attention(
+    q: jnp.ndarray,        # (B, H, N, dh) fp32, node axis seq-sharded
+    k: jnp.ndarray,
+    v: jnp.ndarray,
+    key_pad: jnp.ndarray,  # (B, N), truthy = padded
+    dropout_rate: float = 0.0,
+    dropout_seed: Optional[jnp.ndarray] = None,
+) -> jnp.ndarray:
+    """Ring-parallel dense masked attention (the ``full_att`` family,
+    ref ``sbm_attn.py:69-87``) over the ambient mesh's ``seq`` axis.
+
+    Attention dropout comes from the counter hash stream (same mechanism as
+    the ring SBM path and the flash kernel) rather than ``nn.Dropout`` —
+    identical distribution, different realization.
+    """
+    n, h = q.shape[2], q.shape[1]
+    mesh, seeds, sp, kwargs = _ring_setup(
+        n, h, jnp.zeros((), jnp.int32), dropout_seed, dropout_rate)
+    out = jax.shard_map(
+        partial(_full_local, **kwargs),
+        mesh=mesh,
+        in_specs=(sp["q"], sp["q"], sp["q"], sp["pad"], sp["rep"]),
+        out_specs=sp["q"],
+        check_vma=False,
+    )(q, k, v, key_pad.astype(jnp.float32), seeds)
+    return out
