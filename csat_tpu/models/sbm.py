@@ -50,6 +50,16 @@ def l1_normalize(x: jnp.ndarray, axis: int = -1, eps: float = 1e-12) -> jnp.ndar
     return x / jnp.maximum(norm, eps)
 
 
+def draw_counter_seed(module: nn.Module, name: str) -> jnp.ndarray:
+    """int32 seed for the counter hash stream, derived from the module's
+    ``name`` RNG collection — the one convention both attention families'
+    ring/kernel paths must share so their streams stay aligned."""
+    return jax.random.randint(
+        module.make_rng(name), (), 0, jnp.iinfo(jnp.int32).max,
+        dtype=jnp.int32,
+    )
+
+
 class ClusterProj(nn.Module):
     """3-layer MLP applied to Q and K head vectors (ref ``sbm_attn.py:22-30``)."""
 
@@ -106,10 +116,7 @@ class SBMAttention(nn.Module):
         rate = self.attention_dropout if use_dropout else 0.0
 
         def draw_seed(name: str):
-            return jax.random.randint(
-                self.make_rng(name), (), 0, jnp.iinfo(jnp.int32).max,
-                dtype=jnp.int32,
-            )
+            return draw_counter_seed(self, name)
 
         def head_sparsity(graph_sums):  # ΣA per (batch, head) → per-head
             return jnp.sum(graph_sums, axis=0) / (b * n * n)
@@ -195,11 +202,9 @@ class FullAttention(nn.Module):
 
             if ring_active():
                 rate = self.attention_dropout if not deterministic else 0.0
-                dseed = None
-                if rate > 0.0:
-                    dseed = jax.random.randint(
-                        self.make_rng("dropout"), (), 0,
-                        jnp.iinfo(jnp.int32).max, dtype=jnp.int32)
+                dseed = (
+                    draw_counter_seed(self, "dropout") if rate > 0.0 else None
+                )
                 out = ring_full_attention(q, k, v, key_pad, rate, dseed)
                 return out, None, None, None
         mask = key_pad[:, None, None, :].astype(bool)
