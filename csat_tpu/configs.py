@@ -224,9 +224,11 @@ _reg(_JAVA.replace(name="java_compare_codescribe", data_dir="./processed/compare
 # (sequence/context parallelism); override mesh_shape to enable, e.g.
 # mesh_shape=(("data", -1), ("seq", 2)).
 _reg(_JAVA.replace(name="java_long", task_name="long_ast_512", max_src_len=512,
-                   mesh_shape=(("data", -1),), noise_mode="counter", remat=True))
+                   mesh_shape=(("data", -1),), noise_mode="counter", remat=True,
+                   seq_impl="ring"))
 _reg(_PY.replace(name="python_long", task_name="long_ast_512", max_src_len=512,
-                 mesh_shape=(("data", -1),), noise_mode="counter", remat=True))
+                 mesh_shape=(("data", -1),), noise_mode="counter", remat=True,
+                 seq_impl="ring"))
 
 
 def get_config(name: str, **overrides) -> Config:

@@ -116,6 +116,9 @@ def test_long_ast_config_registered():
     for name in ("java_long", "python_long"):
         cfg = get_config(name)
         assert cfg.max_src_len == 512
+        # long-AST production setting: ring attention over the seq axis
+        # with counter-based sampling (csat_tpu/parallel/ring.py)
+        assert cfg.seq_impl == "ring" and cfg.noise_mode == "counter"
 
 
 def test_multihost_helpers_single_process():
