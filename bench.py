@@ -155,9 +155,9 @@ def _serve(specs_csv: str, soft_budget_s: float) -> None:
         # the axon plugin ignores the env var; the config update is the
         # reliable off-switch (and avoids touching a wedged relay at all)
         jax.config.update("jax_platforms", "cpu")
-    os.makedirs(CACHE_DIR, exist_ok=True)
-    jax.config.update("jax_compilation_cache_dir", CACHE_DIR)
-    jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+    from csat_tpu.utils.cache import enable_compilation_cache
+
+    enable_compilation_cache(CACHE_DIR)
 
     def emit(rec: dict) -> None:
         with open(RESULTS_PATH, "a") as f:

@@ -1,0 +1,41 @@
+"""Persistent XLA compilation cache for the product entry points.
+
+The flagship train step is a large program (batch 64 compiles in minutes
+even on this host's CPU backend, and through the axon remote compiler it is
+the round-3 bench's dominant cost — ``results/perf/tpu_session_r3.md``).
+The cache makes every entry point pay that compile once per program shape:
+``bench.py`` wires it explicitly; the CLI and ``tools/train_real.py`` call
+:func:`enable_compilation_cache` so restarted/resumed runs and repeated
+evals hit warm executables.
+
+Opt out with ``CSAT_TPU_NO_CACHE=1``; relocate with ``CSAT_TPU_CACHE_DIR``.
+"""
+
+from __future__ import annotations
+
+import os
+
+DEFAULT_DIR = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__)))),
+    ".jax_cache",
+)
+
+__all__ = ["enable_compilation_cache"]
+
+
+def enable_compilation_cache(cache_dir: str | None = None) -> str | None:
+    """Point jax at the persistent compilation cache; returns the dir used
+    (None when disabled via ``CSAT_TPU_NO_CACHE``).
+
+    Precedence: ``CSAT_TPU_NO_CACHE`` (any value except ``0``/empty) >
+    ``CSAT_TPU_CACHE_DIR`` > the caller's ``cache_dir`` > the repo-local
+    default — the env vars win so one knob governs every entry point."""
+    if os.environ.get("CSAT_TPU_NO_CACHE", "0") not in ("", "0"):
+        return None
+    cache_dir = os.environ.get("CSAT_TPU_CACHE_DIR") or cache_dir or DEFAULT_DIR
+    import jax
+
+    os.makedirs(cache_dir, exist_ok=True)
+    jax.config.update("jax_compilation_cache_dir", cache_dir)
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+    return cache_dir

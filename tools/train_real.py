@@ -53,6 +53,10 @@ def main() -> None:
 
     jax.config.update("jax_platforms", args.platform)
 
+    from csat_tpu.utils.cache import enable_compilation_cache
+
+    enable_compilation_cache()
+
     from csat_tpu.configs import get_config
     from csat_tpu.data.dataset import ASTDataset
     from csat_tpu.train import Trainer, run_test
