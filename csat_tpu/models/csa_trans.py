@@ -147,7 +147,10 @@ class CSATrans(nn.Module):
         cfg = self.cfg
         b = memory.shape[0]
         dh = cfg.hidden_size // cfg.num_heads
-        zeros = jnp.zeros((b, cfg.num_heads, max_len, dh), dtype=jnp.float32)
+        # buffers must match the compute dtype: the per-step K/V projections
+        # land here via dynamic_update_slice, which requires equal dtypes
+        # (bf16 decode broke on the fp32 literal before r3's bf16 smoke test)
+        zeros = jnp.zeros((b, cfg.num_heads, max_len, dh), dtype=self.dtype)
         cache: Dict[str, Any] = {}
         for i, layer in enumerate(self.decoder.layers):
             cache[f"layer_{i}"] = {

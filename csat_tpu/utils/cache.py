@@ -35,7 +35,13 @@ def enable_compilation_cache(cache_dir: str | None = None) -> str | None:
     cache_dir = os.environ.get("CSAT_TPU_CACHE_DIR") or cache_dir or DEFAULT_DIR
     import jax
 
-    os.makedirs(cache_dir, exist_ok=True)
+    try:
+        os.makedirs(cache_dir, exist_ok=True)
+    except OSError as e:
+        # an unwritable cache location must not turn a cache optimization
+        # into a startup failure — run uncached instead
+        print(f"# compilation cache disabled ({cache_dir}: {e})")
+        return None
     jax.config.update("jax_compilation_cache_dir", cache_dir)
     jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
     return cache_dir

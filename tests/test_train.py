@@ -111,3 +111,33 @@ def test_sbm_training_step_runs(synthetic_corpus, tiny_config):
     assert 0.0 < float(metrics["sparsity"]) < 1.0
     after = np.asarray(state.params["encoder"]["transformer_0"]["SBMAttention_0"]["clusters"])
     assert not np.array_equal(before, after), "cluster embeddings did not update"
+
+
+def test_bfloat16_train_step_and_decode(synthetic_corpus, tiny_config):
+    """compute_dtype='bfloat16' (the MXU production dtype and the bench's
+    headline variants): finite loss, params stay fp32 (master weights),
+    decode produces valid token ids. Previously only bench.py exercised
+    bf16 — a dtype regression would first appear as a failed measurement."""
+    from csat_tpu.train import make_train_step, default_optimizer
+    from csat_tpu.train.state import create_train_state
+
+    cfg = tiny_config.replace(
+        data_dir=synthetic_corpus, compute_dtype="bfloat16")
+    sv, tv = load_vocab(synthetic_corpus)
+    ds = ASTDataset(cfg, "train", sv, tv)
+    batch = next(iterate_batches(ds, cfg.batch_size, shuffle=False))
+    model = make_model(cfg, sv.size(), tv.size())
+    tx = default_optimizer(cfg)
+    state = create_train_state(model, tx, batch, seed=0)
+    leaves = jax.tree.leaves(state.params)
+    assert all(x.dtype == jnp.float32 for x in leaves), "master weights must stay fp32"
+    step = make_train_step(model, tx, cfg)
+    state, metrics = step(state, batch)
+    assert np.isfinite(float(metrics["loss"]))
+    state, metrics = step(state, batch)
+    assert np.isfinite(float(metrics["loss"]))
+
+    y = greedy_decode(model, {"params": state.params}, batch, jax.random.key(0))
+    y = np.asarray(y)
+    assert y.shape[0] == cfg.batch_size
+    assert ((y >= 0) & (y < tv.size())).all()
