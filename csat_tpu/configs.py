@@ -101,6 +101,9 @@ class Config:
     compute_dtype: str = "float32"  # "bfloat16" for MXU-friendly training
     mesh_shape: Tuple[Tuple[str, int], ...] = (("data", 1), ("model", 1))
     decode_with_cache: bool = True
+    # host-side input double-buffering depth (csat_tpu/train/loop.py:
+    # prefetch_batches); 0 = synchronous
+    prefetch: int = 2
     # rematerialize encoder blocks in backward (jax.checkpoint): trades
     # FLOPs for the (B, H, N, N) activation memory — for long-AST configs
     remat: bool = False

@@ -19,9 +19,11 @@ from __future__ import annotations
 
 import json
 import os
+import queue
+import threading
 import time
 from functools import partial
-from typing import Any, Callable, Dict, Iterable, Optional, Tuple
+from typing import Any, Callable, Dict, Iterable, Iterator, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -38,7 +40,66 @@ from csat_tpu.train.decode import greedy_decode
 from csat_tpu.train.loss import label_smoothing_loss
 from csat_tpu.train.state import TrainState, create_train_state, default_optimizer, make_model
 
-__all__ = ["make_train_step", "evaluate_bleu", "run_test", "Trainer"]
+__all__ = ["make_train_step", "evaluate_bleu", "prefetch_batches", "run_test",
+           "Trainer"]
+
+
+def prefetch_batches(batches: Iterable[Batch], mesh, depth: int = 2) -> Iterator:
+    """Host-side double buffering: collate + ``shard_batch`` (the host→HBM
+    transfer) run in a background thread up to ``depth`` batches ahead, so
+    the host input pipeline overlaps the device's async train step instead
+    of serializing with it — the TPU input-pipeline idiom the reference's
+    DataLoader workers approximate on GPU. Order and contents are
+    unchanged; ``depth=0`` degrades to the plain synchronous loop.
+
+    ``shard_batch`` takes the mesh explicitly (jax's ambient mesh is
+    thread-local and would not be visible in the worker)."""
+    if depth <= 0:
+        for b in batches:
+            yield shard_batch(b, mesh)
+        return
+
+    q: "queue.Queue" = queue.Queue(maxsize=depth)
+    _END = object()
+    stop = threading.Event()  # set when the consumer abandons the generator
+
+    def put(item) -> bool:
+        while not stop.is_set():
+            try:
+                q.put(item, timeout=0.2)
+                return True
+            except queue.Full:
+                continue
+        return False
+
+    def worker() -> None:
+        try:
+            for b in batches:
+                if not put(shard_batch(b, mesh)):
+                    return  # consumer gone — stop instead of pinning batches
+        except BaseException as e:  # noqa: BLE001 — re-raised on the consumer side
+            put(e)
+            return
+        put(_END)
+
+    threading.Thread(target=worker, daemon=True).start()
+    try:
+        while True:
+            item = q.get()
+            if item is _END:
+                return
+            if isinstance(item, BaseException):
+                raise item
+            yield item
+    finally:
+        # abnormal exit (train-step error, Ctrl-C, generator close): unblock
+        # the worker and release any queued device-resident batches
+        stop.set()
+        try:
+            while True:
+                q.get_nowait()
+        except queue.Empty:
+            pass
 
 
 def make_train_step(
@@ -300,11 +361,14 @@ class Trainer:
                 jax.profiler.start_trace(os.path.join(self.output_dir, "trace"))
             t0 = time.time()
             losses = []
-            for it, batch in enumerate(iterate_batches(
-                train_ds, cfg.batch_size, shuffle=True, seed=cfg.seed + epoch,
-                num_shards=jax.process_count(), shard_index=jax.process_index(),
+            for it, batch in enumerate(prefetch_batches(
+                iterate_batches(
+                    train_ds, cfg.batch_size, shuffle=True, seed=cfg.seed + epoch,
+                    num_shards=jax.process_count(),
+                    shard_index=jax.process_index(),
+                ),
+                self.mesh, depth=cfg.prefetch,
             )):
-                batch = shard_batch(batch, self.mesh)
                 state, metrics = self.train_step(state, batch)
                 losses.append(metrics["loss"])
                 if it % 50 == 0:

@@ -141,3 +141,21 @@ def test_bfloat16_train_step_and_decode(synthetic_corpus, tiny_config):
     y = np.asarray(y)
     assert y.shape[0] == cfg.batch_size
     assert ((y >= 0) & (y < tv.size())).all()
+
+
+@pytest.mark.slow
+def test_prefetch_matches_synchronous(synthetic_corpus, tiny_config):
+    """Input double-buffering is a pipeline change, not a semantics change:
+    identical batch order, identical loss history."""
+    from csat_tpu.train import Trainer
+
+    def run(depth):
+        cfg = tiny_config.replace(
+            data_dir=synthetic_corpus, num_epochs=2, prefetch=depth)
+        trainer = Trainer(cfg, log=lambda *_: None)
+        sv, tv = load_vocab(synthetic_corpus)
+        ds = ASTDataset(cfg, "train", sv, tv)
+        _, history = trainer.fit(ds, None)
+        return history["loss"]
+
+    np.testing.assert_allclose(run(2), run(0), rtol=0, atol=0)
