@@ -171,25 +171,24 @@ def _decode_dataset(
     multi = mesh is not None and mesh.devices.size > 1
     n_shards = jax.process_count() if host_shard else 1
     shard_ix = jax.process_index() if host_shard else 0
-    # the ambient mesh activates the encoder's seq-sharding constraints and
-    # the ring-attention route inside the jitted decode (same reason
-    # Trainer.fit wraps its loop in set_mesh) — without it a seq-sharded
-    # eval would silently fall back to the unsharded attention path
-    import contextlib
-
-    mesh_ctx = jax.sharding.set_mesh(mesh) if multi else contextlib.nullcontext()
-    with mesh_ctx:
-        for batch in iterate_batches(
-            dataset, cfg.batch_size, shuffle=False, drop_last=False,
-            num_shards=n_shards, shard_index=shard_ix,
-        ):
-            key, sub = jax.random.split(key)
-            batch, real = _pad_batch(batch, cfg.batch_size)
-            target = np.asarray(batch.target)[:real]
-            if multi:
-                batch = shard_batch(batch, mesh)
+    for batch in iterate_batches(
+        dataset, cfg.batch_size, shuffle=False, drop_last=False,
+        num_shards=n_shards, shard_index=shard_ix,
+    ):
+        key, sub = jax.random.split(key)
+        batch, real = _pad_batch(batch, cfg.batch_size)
+        target = np.asarray(batch.target)[:real]
+        if multi:
+            batch = shard_batch(batch, mesh)
+            # the ambient mesh activates the encoder's seq-sharding
+            # constraints and the ring route inside the jitted decode (same
+            # reason Trainer.fit wraps its loop) — scoped to the call so a
+            # suspended/abandoned generator never leaks global mesh state
+            with jax.sharding.set_mesh(mesh):
+                y_pred = np.asarray(decode_fn(params, batch, sub))[:real]
+        else:
             y_pred = np.asarray(decode_fn(params, batch, sub))[:real]
-            yield y_pred, target
+        yield y_pred, target
 
 
 def _allreduce_sums(vec: np.ndarray) -> np.ndarray:

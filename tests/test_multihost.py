@@ -19,7 +19,8 @@ import sys
 import numpy as np
 import pytest
 
-_WORKER = r"""
+# shared 2-process bring-up: platform forcing, coordinator join
+_PREAMBLE = r"""
 import json, os, sys
 os.environ["JAX_PLATFORMS"] = "cpu"
 os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
@@ -30,6 +31,9 @@ from csat_tpu.parallel.host import initialize_multihost, global_mesh, is_primary
 
 coord, pid = sys.argv[1], int(sys.argv[2])
 initialize_multihost(coordinator_address=coord, num_processes=2, process_id=pid)
+"""
+
+_WORKER = _PREAMBLE + r"""
 assert jax.process_count() == 2, jax.process_count()
 assert jax.device_count() == 4, jax.device_count()
 
@@ -83,8 +87,53 @@ print("RESULT " + json.dumps({
 """
 
 
-@pytest.mark.slow
-def test_two_process_distributed_train_step(tmp_path):
+_RING_WORKER = _PREAMBLE + r"""
+
+import numpy as np
+import jax.numpy as jnp
+from jax.experimental import multihost_utils
+from jax.sharding import PartitionSpec as P
+
+from csat_tpu.parallel.ring import ring_sbm_attention
+from tests.test_flash_ops import SEED, _inputs, _xla_mirror
+
+# seq=4 over 4 devices split 2+2 across the processes: ring hops 1->2 and
+# 3->0 cross the process boundary — ppermute really rides the DCN path
+mesh = global_mesh((("seq", 4),))
+q, k, v, q_hat, k_hat, s_aff, pad = _inputs(b=2, h=2, n=64, dh=16, kk=4)
+out_x, gs_x = _xla_mirror(q, k, v, q_hat, k_hat, s_aff, pad, SEED)
+
+rows = slice(32 * pid, 32 * (pid + 1))  # this host's half of the node axis
+def g(x, spec, sl):
+    return multihost_utils.host_local_array_to_global_array(
+        np.asarray(x)[sl], mesh, spec)
+qs = P(None, None, "seq", None)
+args = (
+    g(q, qs, (slice(None), slice(None), rows)),
+    g(k, qs, (slice(None), slice(None), rows)),
+    g(v, qs, (slice(None), slice(None), rows)),
+    g(q_hat, qs, (slice(None), slice(None), rows)),
+    g(k_hat, qs, (slice(None), slice(None), rows)),
+    g(s_aff, P(), slice(None)),
+    g(pad, P(None, "seq"), (slice(None), rows)),
+)
+with jax.sharding.set_mesh(mesh):
+    out, gs = jax.jit(lambda *a: ring_sbm_attention(*a, SEED))(*args)
+    # gs is replicated over the mesh: every addressable shard holds the
+    # full (B, H) array
+    gs_local = np.asarray(gs.addressable_data(0))
+    out_sum = float(jnp.abs(out).sum())  # global reduction over shards
+
+print("RESULT " + json.dumps({
+    "pid": pid,
+    "gs_exact": bool(np.array_equal(gs_local, np.asarray(gs_x))),
+    "out_sum": out_sum,
+    "out_sum_ref": float(np.abs(np.asarray(out_x)).sum()),
+}))
+"""
+
+
+def _run_two_process(worker_src):
     with socket.socket() as s:
         s.bind(("127.0.0.1", 0))
         port = s.getsockname()[1]
@@ -95,7 +144,7 @@ def test_two_process_distributed_train_step(tmp_path):
     env["PYTHONPATH"] = repo_root
     procs = [
         subprocess.Popen(
-            [sys.executable, "-c", _WORKER, coord, str(i)],
+            [sys.executable, "-c", worker_src, coord, str(i)],
             stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
             env=env, cwd=repo_root,
         )
@@ -116,6 +165,26 @@ def test_two_process_distributed_train_step(tmp_path):
                 p.kill()
                 p.wait()
     assert set(results) == {0, 1}
+    return results
+
+
+@pytest.mark.slow
+def test_two_process_ring_attention(tmp_path):
+    """Ring attention with the seq axis spanning two OS processes: the
+    ppermute hops cross the process boundary and the sampled graph must
+    still match the single-host mirror bit-exactly."""
+    results = _run_two_process(_RING_WORKER)
+    for pid in (0, 1):
+        assert results[pid]["gs_exact"], results[pid]
+        assert results[pid]["out_sum"] == pytest.approx(
+            results[pid]["out_sum_ref"], rel=1e-5)
+    assert results[0]["out_sum"] == pytest.approx(
+        results[1]["out_sum"], rel=1e-7)
+
+
+@pytest.mark.slow
+def test_two_process_distributed_train_step(tmp_path):
+    results = _run_two_process(_WORKER)
     assert results[0]["primary"] and not results[1]["primary"]
     # the psum'd update must leave both hosts with identical params + loss
     assert results[0]["loss"] == pytest.approx(results[1]["loss"], rel=1e-6)
