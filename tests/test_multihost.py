@@ -169,7 +169,7 @@ def _run_two_process(worker_src):
 
 
 @pytest.mark.slow
-def test_two_process_ring_attention(tmp_path):
+def test_two_process_ring_attention():
     """Ring attention with the seq axis spanning two OS processes: the
     ppermute hops cross the process boundary and the sampled graph must
     still match the single-host mirror bit-exactly."""
@@ -183,7 +183,7 @@ def test_two_process_ring_attention(tmp_path):
 
 
 @pytest.mark.slow
-def test_two_process_distributed_train_step(tmp_path):
+def test_two_process_distributed_train_step():
     results = _run_two_process(_WORKER)
     assert results[0]["primary"] and not results[1]["primary"]
     # the psum'd update must leave both hosts with identical params + loss

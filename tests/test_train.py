@@ -1,5 +1,7 @@
 """End-to-end: training converges, cached decode ≡ reference-shaped decode."""
 
+import os
+
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -159,3 +161,26 @@ def test_prefetch_matches_synchronous(synthetic_corpus, tiny_config):
         return history["loss"]
 
     np.testing.assert_allclose(run(2), run(0), rtol=0, atol=0)
+
+
+@pytest.mark.slow
+def test_observability_trace_and_scalars(synthetic_corpus, tiny_config, tmp_path):
+    """cfg.profile emits a jax.profiler trace for the first epoch and
+    cfg.scalar_log streams epoch records to scalars.jsonl (the reference's
+    TensorBoard + ProgressBar surface, script/train.py:210-233)."""
+    cfg = tiny_config.replace(
+        data_dir=synthetic_corpus, num_epochs=1, profile=True,
+        scalar_log=True, output_dir=str(tmp_path),
+    )
+    trainer = Trainer(cfg, log=lambda *_: None)
+    ds = ASTDataset(cfg, "train", trainer.src_vocab, trainer.tgt_vocab)
+    trainer.fit(ds, None)
+
+    trace_dir = os.path.join(trainer.output_dir, "trace")
+    assert os.path.isdir(trace_dir) and os.listdir(trace_dir), "no trace emitted"
+    import json
+
+    scalars = os.path.join(trainer.output_dir, "scalars.jsonl")
+    with open(scalars) as f:
+        recs = [json.loads(line) for line in f]
+    assert any("loss" in r and r.get("epoch") == 1 for r in recs)
