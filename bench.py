@@ -67,14 +67,34 @@ def _remaining() -> float:
 # --------------------------------------------------------------------------
 
 def _probe() -> None:
-    """TPU-liveness probe: backend init only, no compile."""
-    import jax  # noqa: F401
+    """TPU-liveness probe: backend init only, no compile.
 
-    devs = jax.devices()
+    Self-limits by running the (potentially forever-blocking) backend init
+    in a daemon thread and exiting when it overruns — a Python SIGALRM
+    handler cannot fire while the main thread is stuck inside the native
+    init call, and the parent SIGKILLing a process that may hold a chip
+    claim is the documented wedge-poisoning mechanism. Exiting promptly
+    ourselves is the cleanest achievable release."""
+    import threading
+
+    result: dict = {}
+
+    def init() -> None:
+        import jax
+
+        devs = jax.devices()
+        result["platform"] = devs[0].platform
+        result["n"] = len(devs)
+
+    t = threading.Thread(target=init, daemon=True)
+    t.start()
+    t.join(max(PROBE_S - 10, 10))
+    if "platform" not in result:
+        os._exit(3)
     print(json.dumps({
         "ok": True,
-        "platform": devs[0].platform,
-        "n_devices": len(devs),
+        "platform": result["platform"],
+        "n_devices": result["n"],
     }))
 
 
