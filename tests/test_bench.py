@@ -1,0 +1,184 @@
+"""Orchestration tests for bench.py's parent logic (no jax, no children).
+
+The bench is the driver's round-end evidence artifact and its failure modes
+are exactly the hostile-environment ones (wedged probe, killed serve child,
+budget cuts) — these tests pin the orchestration by mocking the child
+runner and the results file the serve children would write.
+"""
+
+import importlib
+import json
+
+import pytest
+
+
+@pytest.fixture()
+def bench(tmp_path, monkeypatch):
+    monkeypatch.setenv("BENCH_BUDGET_S", "1200")
+    monkeypatch.setenv("BENCH_PROBE_S", "120")
+    monkeypatch.delenv("BENCH_VARIANTS", raising=False)
+    import bench as mod
+
+    mod = importlib.reload(mod)
+    monkeypatch.setattr(mod, "RESULTS_PATH", str(tmp_path / "results.jsonl"))
+    monkeypatch.setattr(mod, "HERE", str(tmp_path))  # no baseline file
+    return mod
+
+
+def _result(spec, nodes):
+    backend, dtype, platform, _, steps = spec.split(":")
+    return {
+        "ok": True, "backend": backend, "dtype": dtype,
+        "device": "tpu" if platform == "default" else "cpu",
+        "n_chips": 1, "loss": 1.0, "compile_s": 10.0, "steps": int(steps),
+        "step_ms": 1.0, "nodes_per_sec_per_chip": nodes, "spec": spec,
+    }
+
+
+def _emit(mod, rec):
+    with open(mod.RESULTS_PATH, "a") as f:
+        f.write(json.dumps(rec) + "\n")
+
+
+def _run_main(mod, capsys):
+    mod.main()
+    out = capsys.readouterr().out
+    return json.loads(out.strip().splitlines()[-1])
+
+
+def test_alive_tpu_best_variant_wins(bench, monkeypatch, capsys):
+    """Probe alive → device specs served in one group; best nodes/s wins."""
+    calls = []
+
+    def fake_child(args, timeout_s):
+        calls.append(args)
+        if args[0] == "--probe":
+            return {"ok": True, "platform": "tpu", "n_devices": 1}, None
+        for i, spec in enumerate(args[1].split(",")):
+            _emit(bench, {"phase": "start", "spec": spec})
+            _emit(bench, _result(spec, 100.0 + i))
+        _emit(bench, {"phase": "done"})
+        return {"ok": True, "phase": "done"}, None
+
+    monkeypatch.setattr(bench, "_run_child", fake_child)
+    out = _run_main(bench, capsys)
+    assert out["device"] == "tpu"
+    assert out["value"] == 103.0  # the 4th (best) variant
+    assert "degraded" not in out
+    assert len(out["all_variants"]) == 4
+    # one probe + ONE serve for the whole device group (single claim)
+    assert [c[0] for c in calls] == ["--probe", "--serve"]
+
+
+def test_dead_probe_falls_back_to_cpu_specs(bench, monkeypatch, capsys):
+    def fake_child(args, timeout_s):
+        if args[0] == "--probe":
+            return None, "timeout after 120s"
+        specs = args[1].split(",")
+        assert all(s.split(":")[2] == "cpu" for s in specs)
+        for spec in specs:
+            _emit(bench, {"phase": "start", "spec": spec})
+            _emit(bench, _result(spec, 200.0))
+        _emit(bench, {"phase": "done"})
+        return {"ok": True, "phase": "done"}, None
+
+    monkeypatch.setattr(bench, "_run_child", fake_child)
+    out = _run_main(bench, capsys)
+    assert out["degraded"] is True
+    assert out["device"] == "cpu"
+    assert "tpu_probe" in out and "timeout" in out["tpu_probe"]
+
+
+def test_killed_serve_retries_untried_first(bench, monkeypatch, capsys):
+    """A serve child killed mid-variant: the retry round runs the missing
+    specs with the killed one LAST, and the final JSON carries both the
+    pre-kill and retry measurements."""
+    state = {"round": 0}
+
+    def fake_child(args, timeout_s):
+        if args[0] == "--probe":
+            return {"ok": True, "platform": "tpu", "n_devices": 1}, None
+        state["round"] += 1
+        specs = args[1].split(",")
+        if state["round"] == 1:
+            # finishes the first variant, dies inside the second
+            _emit(bench, {"phase": "start", "spec": specs[0]})
+            _emit(bench, _result(specs[0], 100.0))
+            _emit(bench, {"phase": "start", "spec": specs[1]})
+            return None, "timeout after 555s"
+        # retry round: killed spec must be queued last
+        assert specs[-1].startswith("xla:float32"), specs
+        for spec in specs:
+            _emit(bench, {"phase": "start", "spec": spec})
+            _emit(bench, _result(spec, 300.0))
+        _emit(bench, {"phase": "done"})
+        return {"ok": True, "phase": "done"}, None
+
+    monkeypatch.setattr(bench, "_run_child", fake_child)
+    out = _run_main(bench, capsys)
+    assert state["round"] == 2
+    assert len(out["all_variants"]) == 4
+    assert out["value"] == 300.0
+    assert "killed during" not in out.get("notes", "")  # retried successfully
+
+
+def test_deterministic_error_not_retried(bench, monkeypatch, capsys):
+    state = {"serves": 0}
+
+    def fake_child(args, timeout_s):
+        if args[0] == "--probe":
+            return {"ok": True, "platform": "tpu", "n_devices": 1}, None
+        state["serves"] += 1
+        for spec in args[1].split(","):
+            _emit(bench, {"phase": "start", "spec": spec})
+            if spec.startswith("pallas:float32"):
+                _emit(bench, {"phase": "error", "spec": spec,
+                              "error": "FloatingPointError: non-finite"})
+            else:
+                _emit(bench, _result(spec, 150.0))
+        _emit(bench, {"phase": "done"})
+        return {"ok": True, "phase": "done"}, None
+
+    monkeypatch.setattr(bench, "_run_child", fake_child)
+    out = _run_main(bench, capsys)
+    assert state["serves"] == 1  # error is final: no retry round
+    assert "non-finite" in out["notes"]
+    assert len(out["all_variants"]) == 3
+
+
+def test_malformed_bench_variants_flagged(bench, monkeypatch, capsys):
+    monkeypatch.setenv("BENCH_VARIANTS", "xla:float32:cpu,xla:float32:cpu:8:3")
+
+    def fake_child(args, timeout_s):
+        if args[0] == "--probe":
+            return None, "timeout after 120s"
+        for spec in args[1].split(","):
+            assert spec.count(":") == 4
+            _emit(bench, {"phase": "start", "spec": spec})
+            _emit(bench, _result(spec, 90.0))
+        _emit(bench, {"phase": "done"})
+        return {"ok": True, "phase": "done"}, None
+
+    monkeypatch.setattr(bench, "_run_child", fake_child)
+    out = _run_main(bench, capsys)
+    assert "malformed" in out["notes"]
+    assert len(out["all_variants"]) == 1
+
+
+def test_vs_baseline_ratio(bench, monkeypatch, tmp_path, capsys):
+    with open(tmp_path / "baseline_torch.json", "w") as f:
+        json.dump({"ast_nodes_per_sec_per_chip": 100.0, "device": "cpu"}, f)
+
+    def fake_child(args, timeout_s):
+        if args[0] == "--probe":
+            return {"ok": True, "platform": "tpu", "n_devices": 1}, None
+        for spec in args[1].split(","):
+            _emit(bench, {"phase": "start", "spec": spec})
+            _emit(bench, _result(spec, 450.0))
+        _emit(bench, {"phase": "done"})
+        return {"ok": True, "phase": "done"}, None
+
+    monkeypatch.setattr(bench, "_run_child", fake_child)
+    out = _run_main(bench, capsys)
+    assert out["vs_baseline"] == 4.5
+    assert out["baseline_device"] == "cpu"
