@@ -94,3 +94,78 @@ def test_pallas_rejects_seq_sharding():
         )
     # seq axis of size 1 stays legal (degenerate mesh)
     get_config("python", backend="pallas", mesh_shape=(("data", 2), ("seq", 1)))
+
+
+class TestEntryProbeCache:
+    """ADVICE r3: entry()'s accelerator-liveness verdict is persisted on
+    disk so new processes on a healthy host skip the ~30-85 s probe."""
+
+    def _load(self, monkeypatch, tmp_path):
+        import importlib
+        import __graft_entry__ as ge
+
+        ge = importlib.reload(ge)
+        monkeypatch.setattr(ge, "_PROBE_CACHE_PATH", str(tmp_path / "v.json"))
+        return ge
+
+    def test_roundtrip_and_ttl(self, monkeypatch, tmp_path):
+        ge = self._load(monkeypatch, tmp_path)
+        assert ge._read_cached_verdict() is None  # absent
+        ge._write_cached_verdict(True)
+        assert ge._read_cached_verdict() is True
+        ge._write_cached_verdict(False)
+        assert ge._read_cached_verdict() is False
+        # stale dead entries are ignored (600 s TTL)
+        rec = json.loads(open(ge._PROBE_CACHE_PATH).read())
+        rec["t"] -= ge._PROBE_CACHE_TTL_S + 1
+        open(ge._PROBE_CACHE_PATH, "w").write(json.dumps(rec))
+        assert ge._read_cached_verdict() is None
+        # alive entries expire on the SHORT TTL: a stale alive verdict would
+        # bypass the hang protection (code-review r4 finding)
+        ge._write_cached_verdict(True)
+        rec = json.loads(open(ge._PROBE_CACHE_PATH).read())
+        rec["t"] -= ge._PROBE_CACHE_ALIVE_TTL_S + 1
+        open(ge._PROBE_CACHE_PATH, "w").write(json.dumps(rec))
+        assert ge._read_cached_verdict() is None
+
+    def test_corrupt_cache_ignored(self, monkeypatch, tmp_path):
+        ge = self._load(monkeypatch, tmp_path)
+        open(ge._PROBE_CACHE_PATH, "w").write("{not json")
+        assert ge._read_cached_verdict() is None
+
+    def test_skip_probe_env(self, monkeypatch, tmp_path):
+        ge = self._load(monkeypatch, tmp_path)
+        monkeypatch.delenv("JAX_PLATFORMS", raising=False)
+        called = {"n": 0}
+        monkeypatch.setattr(
+            ge, "_read_cached_verdict",
+            lambda: called.__setitem__("n", called["n"] + 1) or None)
+        monkeypatch.setenv("CSAT_TPU_SKIP_PROBE", "1")
+        ge._device_backend_or_cpu()
+        assert ge._PROBE_ALIVE is True  # assumed alive, no probe subprocess
+        assert called["n"] == 0  # disk cache not even consulted
+        ge._PROBE_ALIVE = None
+        monkeypatch.setenv("CSAT_TPU_SKIP_PROBE", "cpu")
+        # force-cpu path calls jax.config.update; conftest already pinned cpu
+        ge._device_backend_or_cpu()
+        assert ge._PROBE_ALIVE is False
+        # "0" means UNSET (probe normally), not force-cpu
+        ge._PROBE_ALIVE = None
+        monkeypatch.setenv("CSAT_TPU_SKIP_PROBE", "0")
+        monkeypatch.setattr(ge, "_read_cached_verdict", lambda: True)
+        ge._device_backend_or_cpu()
+        assert ge._PROBE_ALIVE is True  # came from the disk cache, not env
+
+    def test_disk_verdict_respected(self, monkeypatch, tmp_path):
+        ge = self._load(monkeypatch, tmp_path)
+        monkeypatch.delenv("JAX_PLATFORMS", raising=False)
+        monkeypatch.delenv("CSAT_TPU_SKIP_PROBE", raising=False)
+
+        def boom(*a, **k):
+            raise AssertionError("probe subprocess must not run")
+
+        import subprocess
+        monkeypatch.setattr(subprocess, "run", boom)
+        ge._write_cached_verdict(True)
+        ge._device_backend_or_cpu()
+        assert ge._PROBE_ALIVE is True

@@ -165,6 +165,30 @@ def test_malformed_bench_variants_flagged(bench, monkeypatch, capsys):
     assert len(out["all_variants"]) == 1
 
 
+def test_done_record_authoritative_over_stdout_marker(bench, monkeypatch, capsys):
+    """A serve child that wrote its 'done' phase but lost its stdout marker
+    (truncated pipe, late nonzero exit) is a SUCCESS: no serve-error note,
+    no retry round (ADVICE r3)."""
+    state = {"serves": 0}
+
+    def fake_child(args, timeout_s):
+        if args[0] == "--probe":
+            return {"ok": True, "platform": "tpu", "n_devices": 1}, None
+        state["serves"] += 1
+        for spec in args[1].split(","):
+            _emit(bench, {"phase": "start", "spec": spec})
+            _emit(bench, _result(spec, 120.0))
+        _emit(bench, {"phase": "done"})
+        return None, "no result line in child output"  # marker lost
+
+    monkeypatch.setattr(bench, "_run_child", fake_child)
+    out = _run_main(bench, capsys)
+    assert state["serves"] == 1  # done record suppressed the retry round
+    assert "serve:" not in out.get("notes", "")
+    assert len(out["all_variants"]) == 4
+    assert "degraded" not in out
+
+
 def test_vs_baseline_ratio(bench, monkeypatch, tmp_path, capsys):
     with open(tmp_path / "baseline_torch.json", "w") as f:
         json.dump({"ast_nodes_per_sec_per_chip": 100.0, "device": "cpu"}, f)
