@@ -10,10 +10,15 @@ This implementation reproduces the jar's pipeline natively, no JVM:
 
 * **normalization** (the ``-norm`` flag): lowercase + punctuation split off
   into separate tokens;
-* **staged matching**: exact matches (weight 1.0) preferred over Porter-stem
-  matches (weight 0.6), one-to-one alignment maximizing the number of matched
-  words and, among maximal matchings, minimizing the chunk count — the same
-  objective as the jar's beam-search aligner;
+* **staged matching**: exact matches (weight 1.0), then Porter-stem matches
+  (weight 0.6), then synonym matches (weight 0.8, compact embedded
+  WordNet-style table ``synonyms_en.txt``, stem-indexed) — one-to-one
+  alignment maximizing the number of matched words and, among maximal
+  matchings, maximizing module weight then minimizing the chunk count — the
+  same objective as the jar's beam-search aligner. Stage order mirrors the
+  jar (a stem-equal pair is claimed by the stem module even when the words
+  also share a synonym group); the 1.5 English module weights are the jar's
+  ``1.0 0.6 0.8`` for exact/stem/synonym;
 * **METEOR-1.5 English parameters** (``-l en``): α=0.85, β=0.2, γ=0.6,
   δ=0.75 with content/function-word weighting
   (Denkowski & Lavie 2014, "Meteor Universal"):
@@ -26,9 +31,12 @@ Documented deltas vs the jar (which cannot be run — the blob is absent):
 the jar uses the Snowball English stemmer (Porter2) — here the classic
 Porter (1980) algorithm, which agrees on the vast majority of English
 tokens; the jar's function-word list ships inside the jar — here a standard
-compact English function-word list; the jar has a synonym stage backed by
-WordNet — omitted (no WordNet in the image), so scores are a lower bound of
-the jar's whenever a synonym-only match exists.
+compact English function-word list; the jar's synonym module consults full
+WordNet — here a compact embedded table (~500 groups, biased toward
+code-summary vocabulary), so a synonym-only match outside the table is
+still missed (a much smaller residual than omitting the stage entirely);
+the jar's final *paraphrase* module (phrase table, weight 0.6) remains
+omitted — the phrase-table blob is absent from the reference too.
 
 The classic 2005 exact-match formulation (Banerjee & Lavie) is retained as
 ``version="2005"``. A native (C++) drop-in with the same semantics lives in
@@ -38,6 +46,7 @@ differential tests hold the two together.
 
 from __future__ import annotations
 
+import os
 from typing import Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
@@ -46,11 +55,13 @@ __all__ = ["Meteor", "meteor_score", "porter_stem", "normalize_tokens"]
 
 # METEOR-1.5 English task parameters (Denkowski & Lavie 2014, `-l en`).
 ALPHA, BETA, GAMMA, DELTA = 0.85, 0.2, 0.6, 0.75
-W_EXACT, W_STEM = 1.0, 0.6
-# integer module weights (exact=5, stem=3, i.e. ×5) used inside the
+W_EXACT, W_STEM, W_SYN = 1.0, 0.6, 0.8
+# integer module weights (exact=5, syn=4, stem=3, i.e. ×5) used inside the
 # alignment search so weight ties are exact — float accumulation order
-# would otherwise defeat the min-chunk tiebreak
-WI_EXACT, WI_STEM, WI_SCALE = 5, 3, 5
+# would otherwise defeat the min-chunk tiebreak. Stage order mirrors the
+# jar (exact → stem → synonym): a pair equal under the stemmer is claimed
+# by the stem module even when the two words also share a synonym group.
+WI_EXACT, WI_STEM, WI_SYN, WI_SCALE = 5, 3, 4, 5
 
 # Standard English function words (articles, auxiliaries, conjunctions,
 # prepositions, pronouns, punctuation). The jar loads its list from a
@@ -217,6 +228,53 @@ def porter_stem(word: str) -> str:
 
 
 # ---------------------------------------------------------------------------
+# Synonym table (the jar's WordNet synonym module, stage 3)
+# ---------------------------------------------------------------------------
+
+_SYN_PATH = os.path.join(
+    os.path.dirname(os.path.abspath(__file__)), "synonyms_en.txt")
+_SYN_INDEX: Optional[Dict[str, frozenset]] = None
+
+
+def _synonym_index() -> Dict[str, frozenset]:
+    """``porter_stem(word) → frozenset(group ids)`` from ``synonyms_en.txt``.
+
+    Stem-indexed so inflected forms share their lemma's synsets ("creates" →
+    stem "creat" → the groups of "create") — the jar reaches the same effect
+    through WordNet's morphological processor. Loaded once per process; an
+    unreadable table degrades to an empty index (scores fall back to
+    exact+stem, never crash).
+    """
+    global _SYN_INDEX
+    if _SYN_INDEX is None:
+        index: Dict[str, set] = {}
+        try:
+            with open(_SYN_PATH, encoding="utf-8") as f:
+                gid = 0
+                for line in f:
+                    line = line.strip()
+                    if not line or line.startswith("#"):
+                        continue
+                    for word in line.split():
+                        index.setdefault(porter_stem(word), set()).add(gid)
+                    gid += 1
+        except OSError:
+            pass
+        _SYN_INDEX = {k: frozenset(v) for k, v in index.items()}
+    return _SYN_INDEX
+
+
+def synonym_match(a_stem: str, b_stem: str) -> bool:
+    """True when two (stemmed) tokens share a synonym group."""
+    idx = _synonym_index()
+    ga = idx.get(a_stem)
+    if not ga:
+        return False
+    gb = idx.get(b_stem)
+    return bool(gb) and not ga.isdisjoint(gb)
+
+
+# ---------------------------------------------------------------------------
 # Normalization (the jar's -norm flag: lowercase + punctuation tokenization)
 # ---------------------------------------------------------------------------
 
@@ -311,7 +369,9 @@ def _align(
     n, r = len(hyp), len(ref)
     h_stem = [porter_stem(t) for t in hyp] if use_stem else None
     r_stem = [porter_stem(t) for t in ref] if use_stem else None
-    # edge list per hyp position: (ref_pos, integer module weight)
+    # edge list per hyp position: (ref_pos, integer module weight); stage
+    # order mirrors the jar: exact → stem → synonym (use_stem gates both
+    # morphology-aware stages — the 2005 mode is exact-only)
     edges: List[List[Tuple[int, int]]] = []
     for i in range(n):
         cand: List[Tuple[int, int]] = []
@@ -320,6 +380,8 @@ def _align(
                 cand.append((j, WI_EXACT))
             elif use_stem and h_stem[i] == r_stem[j]:
                 cand.append((j, WI_STEM))
+            elif use_stem and synonym_match(h_stem[i], r_stem[j]):
+                cand.append((j, WI_SYN))
         edges.append(cand)
 
     if n > 256 or r > 256:

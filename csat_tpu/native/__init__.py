@@ -60,8 +60,20 @@ def load_meteor() -> Optional[ctypes.CDLL]:
         lib = ctypes.CDLL(lib_path)
         lib.meteor_score_c.argtypes = [ctypes.c_char_p, ctypes.c_char_p, ctypes.c_int]
         lib.meteor_score_c.restype = ctypes.c_double
+        # feed the synonym table (single source of truth shared with the
+        # Python scorer); a stale pre-synonym .so lacks the symbol → treat
+        # as unavailable so Python (which has the stage) stays authoritative
+        lib.meteor_set_synonyms_c.argtypes = [ctypes.c_char_p]
+        lib.meteor_set_synonyms_c.restype = None
+        syn_path = os.path.join(
+            os.path.dirname(_HERE), "metrics", "synonyms_en.txt")
+        try:
+            with open(syn_path, "rb") as f:
+                lib.meteor_set_synonyms_c(f.read())
+        except OSError:
+            lib.meteor_set_synonyms_c(b"")
         _LIB = lib
-    except OSError:
+    except (OSError, AttributeError):
         # read-only install dir, missing sources, unloadable library — the
         # pure-Python scorer is the always-available fallback
         return None

@@ -11,7 +11,9 @@
 //     stem ordering; on node-cap the best *complete* solution found so far
 //     is used, so the (matches, chunks) pair is always consistent);
 //   * METEOR-1.5 English parameters alpha=.85 beta=.2 gamma=.6 delta=.75,
-//     module weights exact=1.0 stem=0.6, content/function-word weighting;
+//     module weights exact=1.0 stem=0.6 synonym=0.8, content/function-word
+//     weighting; the synonym table (stem-indexed groups) is fed at load
+//     time from csat_tpu/metrics/synonyms_en.txt via meteor_set_synonyms_c;
 //   * Porter (1980) stemmer (the jar uses Snowball English — documented
 //     delta in the Python module docstring).
 //
@@ -30,15 +32,18 @@
 #include <set>
 #include <sstream>
 #include <string>
+#include <unordered_map>
 #include <vector>
 
 namespace {
 
 constexpr double ALPHA = 0.85, BETA = 0.2, GAMMA = 0.6, DELTA = 0.75;
-constexpr double W_EXACT = 1.0, W_STEM = 0.6;
+constexpr double W_EXACT = 1.0, W_STEM = 0.6, W_SYN = 0.8;
 // integer module weights (x5) inside the alignment search so weight ties
-// are exact — mirrors csat_tpu/metrics/meteor.py WI_EXACT/WI_STEM
-constexpr int WI_EXACT = 5, WI_STEM = 3, WI_SCALE = 5;
+// are exact — mirrors csat_tpu/metrics/meteor.py WI_EXACT/WI_STEM/WI_SYN.
+// Stage order mirrors the jar: exact → stem → synonym (a stem-equal pair
+// is claimed by the stem module even when the words also share a group).
+constexpr int WI_EXACT = 5, WI_STEM = 3, WI_SYN = 4, WI_SCALE = 5;
 
 std::vector<std::string> tokenize(const char* s) {
     std::vector<std::string> out;
@@ -230,6 +235,48 @@ std::string porter_stem(const std::string& word) {
 }
 
 // ------------------------------------------------------------------
+// Synonym table (stage 3) — stem-indexed groups fed once from Python
+// via meteor_set_synonyms_c (single source of truth: synonyms_en.txt)
+// ------------------------------------------------------------------
+
+std::unordered_map<std::string, std::vector<int>>& synonym_index() {
+    static std::unordered_map<std::string, std::vector<int>> index;
+    return index;
+}
+
+void set_synonyms(const char* data) {
+    auto& index = synonym_index();
+    index.clear();
+    std::istringstream iss(data);
+    std::string line;
+    int gid = 0;
+    while (std::getline(iss, line)) {
+        if (line.empty() || line[0] == '#') continue;
+        std::istringstream ls(line);
+        std::string word;
+        bool any = false;
+        while (ls >> word) {
+            index[porter_stem(word)].push_back(gid);
+            any = true;
+        }
+        if (any) ++gid;
+    }
+    for (auto& [k, v] : index) {
+        std::sort(v.begin(), v.end());
+        v.erase(std::unique(v.begin(), v.end()), v.end());
+    }
+}
+
+bool groups_intersect(const std::vector<int>& a, const std::vector<int>& b) {
+    size_t i = 0, j = 0;
+    while (i < a.size() && j < b.size()) {
+        if (a[i] == b[j]) return true;
+        if (a[i] < b[j]) ++i; else ++j;
+    }
+    return false;
+}
+
+// ------------------------------------------------------------------
 // Alignment: max matches, then max weight, then min chunks
 // ------------------------------------------------------------------
 
@@ -255,9 +302,18 @@ struct Aligner {
             bool use_stem, long cap)
         : hyp(h), ref(r), node_cap(cap) {
         std::vector<std::string> hs, rs;
+        std::vector<const std::vector<int>*> hg, rg;
+        static const std::vector<int> kNoGroups;
         if (use_stem) {
+            const auto& index = synonym_index();
+            auto lookup = [&](const std::string& stem) {
+                auto it = index.find(stem);
+                return it == index.end() ? &kNoGroups : &it->second;
+            };
             for (const auto& t : h) hs.push_back(porter_stem(t));
             for (const auto& t : r) rs.push_back(porter_stem(t));
+            for (const auto& s : hs) hg.push_back(lookup(s));
+            for (const auto& s : rs) rg.push_back(lookup(s));
         }
         edges.resize(h.size());
         for (size_t i = 0; i < h.size(); ++i)
@@ -266,6 +322,8 @@ struct Aligner {
                     edges[i].push_back({(int)j, WI_EXACT});
                 else if (use_stem && hs[i] == rs[j])
                     edges[i].push_back({(int)j, WI_STEM});
+                else if (use_stem && groups_intersect(*hg[i], *rg[j]))
+                    edges[i].push_back({(int)j, WI_SYN});
             }
         used.assign(r.size(), 0);
     }
@@ -374,6 +432,12 @@ double content_weight(const std::string& tok) {
 }  // namespace
 
 extern "C" {
+
+// Load/replace the synonym table (whitespace-separated groups, one per
+// line, '#' comments). Called once by the Python loader with the contents
+// of csat_tpu/metrics/synonyms_en.txt. NOT thread-safe vs concurrent
+// scoring — call before the first meteor_score_c.
+void meteor_set_synonyms_c(const char* data) { set_synonyms(data); }
 
 double meteor_score_c(const char* hyp_s, const char* ref_s, int v15) {
     auto hyp = tokenize(hyp_s);

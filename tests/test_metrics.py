@@ -153,6 +153,10 @@ def test_native_meteor_matches_python():
     vocab = [
         "the", "cat", "sat", "on", "mat", "a", "dog", "ran", "fast", "very",
         "running", "runs", "sorted", "sorting", "items", "lists", "list",
+        # synonym-table members (incl. inflections) so the differential
+        # covers the stage-3 module and its stem-indexed lookup
+        "creates", "makes", "built", "removes", "deletes", "large", "big",
+        "error", "mistake", "quickly", "fetches", "retrieves",
     ]
     for version in ("1.5", "2005"):
         for _ in range(200):
@@ -182,3 +186,48 @@ def test_meteor_exact_preferred_over_stem():
 
     a = _align(["runs"], ["running", "runs"])
     assert a.matches == 1 and a.pairs == [(0, 1, 1.0)]
+
+
+def test_meteor_synonym_stage():
+    """Stage-3 synonym matches (compact embedded table): weight 0.8, below
+    exact (1.0), above stem (0.6); stem-indexed so inflections match."""
+    from csat_tpu.metrics.meteor import meteor_score, synonym_match, porter_stem
+
+    # table groups: "make create build ..." / "big large huge ..."
+    assert synonym_match(porter_stem("creates"), porter_stem("makes"))
+    assert synonym_match(porter_stem("big"), porter_stem("large"))
+    assert not synonym_match(porter_stem("big"), porter_stem("small"))
+    assert not synonym_match(porter_stem("zebra"), porter_stem("yak"))
+
+    for native in (False, True):
+        exact = meteor_score(["creates", "a", "list"],
+                             ["creates", "a", "list"], use_native=native)
+        syn = meteor_score(["creates", "a", "list"],
+                           ["makes", "a", "list"], use_native=native)
+        none = meteor_score(["creates", "a", "list"],
+                            ["destroys", "a", "list"], use_native=native)
+        assert exact > syn > none, (native, exact, syn, none)
+
+    # synonym-only pair scores > 0 (pre-synonym scorer gave 0.0 here)
+    assert meteor_score(["large"], ["big"], use_native=False) > 0.0
+    # 2005 mode stays exact-only
+    assert meteor_score(["large"], ["big"], version="2005") == 0.0
+
+
+def test_meteor_stage_order_stem_claims_before_synonym():
+    """A pair equal under the stemmer is the stem module's (0.6) even if the
+    words also share a synonym group — the jar's stage order."""
+    from csat_tpu.metrics.meteor import WI_STEM, _align
+
+    # "creates"/"creating" stem-match AND share the create-group
+    a = _align(["creates"], ["creating"])
+    assert a.matches == 1
+    assert a.pairs[0][2] == WI_STEM / 5.0
+
+
+def test_meteor_synonym_weight_between_stem_and_exact():
+    from csat_tpu.metrics.meteor import _align, WI_EXACT, WI_STEM, WI_SYN
+
+    assert WI_STEM < WI_SYN < WI_EXACT
+    a = _align(["fetches"], ["retrieves"])  # different stems, same group
+    assert a.matches == 1 and a.pairs[0][2] == WI_SYN / 5.0

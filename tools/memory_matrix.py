@@ -1,0 +1,116 @@
+"""Step-time / peak-memory matrix over (N, remat, kernel backend).
+
+Runs ``tools/time_memory.py`` once per combination in a fresh process (so
+peak-RSS and live-buffer readings do not bleed across combos) and writes a
+JSONL + a compact summary table. This is the evidence artifact for the
+long-AST memory story (VERDICT r3 "what's missing" #3): remat on/off and
+flash-vs-fused-vs-XLA at N=150 vs N=512.
+
+Presets:
+
+* ``--device cpu`` (default): XLA-backend combos only, small batch — the
+  pallas kernels only *interpret* on CPU, so their CPU step time / memory
+  is not evidence of anything; and CPU has no device memory stats, so the
+  recorded bounds are live-buffer floors + host-RSS ceilings.
+* ``--device tpu``: full matrix incl. pallas flash (counter noise) and
+  fused (shared noise) at the reference batch 64, reading real
+  ``peak_bytes_in_use`` from HBM. Run this inside a healthy chip window —
+  each combo is one fresh process; the per-run soft budget keeps a single
+  claim short (see results/perf/tpu_session_r3.md for the claim rules).
+
+    python tools/memory_matrix.py --device cpu --out results/perf/memory_matrix_cpu_r4.jsonl
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+import time
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+REPO = os.path.dirname(HERE)
+
+
+def combos(device: str):
+    if device == "tpu":
+        batch = "64"
+        kernels = [
+            ("xla", "shared"),  # baseline XLA lowering
+            ("pallas", "counter"),  # flash kernel, in-kernel sampling
+            ("pallas", "shared"),  # fused kernel, HBM noise stream
+        ]
+        reps, steps = "5", "4"
+    else:
+        batch = "8"
+        kernels = [("xla", "shared")]
+        reps, steps = "3", "2"
+    for n in ("150", "512"):
+        for remat in ("0", "1"):
+            for backend, noise in kernels:
+                yield {
+                    "max_src_len": n, "remat": remat, "backend": backend,
+                    "noise_mode": noise, "batch": batch, "reps": reps,
+                    "steps": steps,
+                }
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--device", choices=("cpu", "tpu"), default="cpu")
+    ap.add_argument("--config", default="python")
+    ap.add_argument("--out", default="")
+    ap.add_argument("--timeout", type=float, default=900.0,
+                    help="per-combo hard cap (fresh process each)")
+    args = ap.parse_args()
+    out_path = args.out or os.path.join(
+        REPO, "results", "perf", f"memory_matrix_{args.device}.jsonl")
+    os.makedirs(os.path.dirname(out_path), exist_ok=True)
+
+    rows = []
+    for combo in combos(args.device):
+        cmd = [sys.executable, os.path.join(HERE, "time_memory.py"),
+               "--config", args.config,
+               "--batch", combo["batch"], "--reps", combo["reps"],
+               "--steps", combo["steps"], "--max_src_len", combo["max_src_len"],
+               "--remat", combo["remat"], "--backend", combo["backend"],
+               "--noise_mode", combo["noise_mode"]]
+        if args.device == "cpu":
+            cmd += ["--platform", "cpu"]
+        t0 = time.time()
+        try:
+            proc = subprocess.run(cmd, capture_output=True, text=True,
+                                  timeout=args.timeout, cwd=REPO)
+        except subprocess.TimeoutExpired:
+            rec = {"combo": combo, "error": f"timeout {args.timeout}s"}
+            rows.append(rec)
+            _append(out_path, rec)
+            continue
+        if proc.returncode != 0:
+            tail = (proc.stderr or "").strip().splitlines()[-2:]
+            rec = {"combo": combo, "error": f"rc={proc.returncode}: {' | '.join(tail)}"}
+        else:
+            try:
+                rec = json.loads(proc.stdout.strip().splitlines()[-1])
+            except (json.JSONDecodeError, IndexError):
+                rec = {"combo": combo, "error": "no JSON in child output"}
+        rec["wall_s"] = round(time.time() - t0, 1)
+        rows.append(rec)
+        _append(out_path, rec)
+        print(json.dumps(rec), file=sys.stderr)
+
+    ok = [r for r in rows if "error" not in r]
+    print(json.dumps({"device": args.device, "n_ok": len(ok),
+                      "n_failed": len(rows) - len(ok), "out": out_path}))
+
+
+def _append(path: str, rec: dict) -> None:
+    with open(path, "a") as f:
+        f.write(json.dumps(rec) + "\n")
+        f.flush()
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,136 @@
+"""Ring attention at the registered long-AST size (N=512): parity + timing.
+
+The ``python_long``/``java_long`` configs register ``max_src_len=512,
+noise_mode="counter", seq_impl="ring", remat=True`` — but until round 4
+nothing had ever executed the ring path at that size (VERDICT r3 weak #3;
+every ring test ran N≤64/128). This tool runs the exact registered
+combination end-to-end at tiny model dims and records:
+
+1. kernel-level parity: ``ring_sbm_attention`` on a data×seq mesh at N=512
+   vs the single-device materialized-noise mirror (bit-identical ΣA,
+   fp32-tolerance outputs);
+2. end-to-end train-step parity: dp2×sp4 ``seq_impl="ring"`` vs
+   ``seq_impl="allgather"`` loss on the same batch — ring must be a pure
+   communication choice;
+3. wall times (compile + steady-state step) for the artifact.
+
+On CPU this runs under the 8-virtual-device platform; on a real multichip
+TPU the same code paths ride ICI. Writes one JSON to --out.
+
+    XLA_FLAGS=--xla_force_host_platform_device_count=8 JAX_PLATFORMS=cpu \
+        python tools/ring512_check.py --out results/perf/ring512_cpu_r4.json
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--out", default="")
+    ap.add_argument("--n", type=int, default=512)
+    ap.add_argument("--steps", type=int, default=3)
+    args = ap.parse_args()
+
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=8").strip()
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from csat_tpu.parallel import build_mesh
+    from csat_tpu.parallel.ring import ring_sbm_attention
+
+    report: dict = {"n": args.n, "device": jax.devices()[0].platform,
+                    "n_devices": jax.device_count()}
+
+    # ---- 1. kernel-level ring@N parity vs materialized-noise mirror ------
+    sys.path.insert(0, os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__)))))
+    from tests.test_flash_ops import SEED, _inputs, _xla_mirror
+
+    b, h, dh, kk = 1, 2, 16, 4
+    qargs = _inputs(b=b, h=h, n=args.n, dh=dh, kk=kk)
+    t0 = time.perf_counter()
+    out_x, gs_x = _xla_mirror(*qargs, SEED)
+    jax.block_until_ready(out_x)
+    mirror_s = time.perf_counter() - t0
+
+    mesh = build_mesh((("data", 1), ("seq", 4)))
+    qs = NamedSharding(mesh, P("data", None, "seq", None))
+    with jax.sharding.set_mesh(mesh):
+        sharded = (
+            *(jax.device_put(t, qs) for t in qargs[:5]),
+            jax.device_put(qargs[5], NamedSharding(mesh, P())),
+            jax.device_put(qargs[6], NamedSharding(mesh, P("data", "seq"))),
+        )
+        ring_fn = jax.jit(lambda *a: ring_sbm_attention(*a, SEED))
+        t0 = time.perf_counter()
+        out_r, gs_r = jax.block_until_ready(ring_fn(*sharded))
+        compile_s = time.perf_counter() - t0
+        t0 = time.perf_counter()
+        for _ in range(args.steps):
+            out_r, gs_r = ring_fn(*sharded)
+        jax.block_until_ready(out_r)
+        step_s = (time.perf_counter() - t0) / args.steps
+
+    graph_identical = bool(np.array_equal(np.asarray(gs_r), np.asarray(gs_x)))
+    max_abs = float(np.max(np.abs(np.asarray(out_r) - np.asarray(out_x))))
+    report["kernel"] = {
+        "graph_sums_bit_identical": graph_identical,
+        "out_max_abs_diff": max_abs,
+        "ring_compile_s": round(compile_s, 1),
+        "ring_step_s": round(step_s, 3),
+        "mirror_first_call_s": round(mirror_s, 1),
+        "shapes": {"b": b, "h": h, "n": args.n, "dh": dh, "kk": kk},
+    }
+    ok_kernel = graph_identical and max_abs < 2e-5
+
+    # ---- 2. end-to-end train step at the registered long config ----------
+    from csat_tpu.parallel.dryrun import dryrun_train_step, tiny_multichip_config
+
+    base = tiny_multichip_config(8, data=2, model_par=1, seq_par=4).replace(
+        max_src_len=args.n, noise_mode="counter", remat=True,
+        attention_dropout=0.0,
+    )
+    t0 = time.perf_counter()
+    loss_ag, _ = dryrun_train_step(8, model_par=1, seq_par=4, cfg=base)
+    ag_s = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    loss_ring, info = dryrun_train_step(
+        8, model_par=1, seq_par=4, cfg=base.replace(seq_impl="ring"))
+    ring_s = time.perf_counter() - t0
+    report["train_step"] = {
+        "loss_allgather": round(float(loss_ag), 6),
+        "loss_ring": round(float(loss_ring), 6),
+        "abs_diff": round(abs(float(loss_ring) - float(loss_ag)), 6),
+        "mesh": info["mesh"],
+        "remat": True,
+        "allgather_wall_s": round(ag_s, 1),
+        "ring_wall_s": round(ring_s, 1),
+    }
+    ok_e2e = abs(float(loss_ring) - float(loss_ag)) < 1e-3
+    report["ok"] = bool(ok_kernel and ok_e2e)
+
+    if args.out:
+        os.makedirs(os.path.dirname(args.out) or ".", exist_ok=True)
+        with open(args.out, "w") as f:
+            json.dump(report, f, indent=1)
+    print(json.dumps(report))
+    sys.exit(0 if report["ok"] else 1)
+
+
+if __name__ == "__main__":
+    main()

@@ -7,8 +7,11 @@ seconds and peak device memory.
 
 TPU translation: ``torch.cuda.Event`` timing → ``block_until_ready`` around
 jitted calls; ``memory_stats()["allocated_bytes.all.peak"]`` →
-``device.memory_stats()["peak_bytes_in_use"]`` (0 when the backend does not
-expose it, e.g. CPU).
+``device.memory_stats()["peak_bytes_in_use"]``. On CPU the backend exposes
+no stats, so two best-effort bounds are recorded instead: ``*_live_gb``
+(sum of live device buffers after the sweep — a floor: residents only) and
+``*_host_rss_peak_gb`` (process peak RSS — a ceiling: includes the Python
+runtime; monotone across sweeps).
 
     python tools/time_memory.py [--config python] [--backend pallas]
                                 [--batch 64] [--reps 20] [--steps 8]
@@ -36,6 +39,29 @@ def peak_bytes() -> int:
         return 0
 
 
+def live_bytes() -> int:
+    """Sum of currently-live device buffers — a best-effort floor for CPU,
+    where the backend exposes no ``memory_stats()``. Captures residents
+    (params, opt state, batches, last outputs) but NOT transient peaks
+    inside a step; the host-RSS peak below bounds those from above."""
+    try:
+        return sum(int(x.nbytes) for x in jax.live_arrays())
+    except Exception:
+        return 0
+
+
+def host_rss_peak_bytes() -> int:
+    """Process-lifetime peak RSS (linux ru_maxrss is KiB). Monotone over the
+    run, so the fwd-sweep reading is a valid bound for the fwd phase and the
+    final reading bounds fwd+bwd; includes Python/runtime overhead."""
+    try:
+        import resource
+
+        return resource.getrusage(resource.RUSAGE_SELF).ru_maxrss * 1024
+    except Exception:
+        return 0
+
+
 def main() -> None:
     ap = argparse.ArgumentParser()
     ap.add_argument("--config", default="python")
@@ -46,6 +72,13 @@ def main() -> None:
     ap.add_argument("--steps", type=int, default=8, help="batches per rep")
     ap.add_argument("--platform", default="",
                     help="force a jax platform (e.g. cpu) pre-backend-init")
+    ap.add_argument("--max_src_len", type=int, default=0,
+                    help="override AST length N (0 = config default)")
+    ap.add_argument("--remat", default="",
+                    help="'1'/'0' to override cfg.remat (''=config default)")
+    ap.add_argument("--noise_mode", default="",
+                    help="override noise_mode (counter routes pallas to the "
+                         "flash kernel; shared to the fused kernel)")
     args = ap.parse_args()
     if args.platform:
         # jax is already imported at module top, so only the config update
@@ -62,6 +95,12 @@ def main() -> None:
         overrides["backend"] = args.backend
     if args.compute_dtype:
         overrides["compute_dtype"] = args.compute_dtype
+    if args.max_src_len:
+        overrides["max_src_len"] = args.max_src_len
+    if args.remat:
+        overrides["remat"] = args.remat == "1"
+    if args.noise_mode:
+        overrides["noise_mode"] = args.noise_mode
     cfg = get_config(args.config, **overrides)
     src_v, tgt_v, trip_v = 10_000, 20_000, 1246
     batches = [
@@ -92,6 +131,8 @@ def main() -> None:
         jax.block_until_ready(out)
         fwd_times.append(time.perf_counter() - t0)
     fwd_peak = peak_bytes()
+    fwd_live = live_bytes()
+    fwd_rss = host_rss_peak_bytes()
 
     # --- forward+backward sweep (ref :129-149) ---
     state, m = step(state, batches[0])  # compile
@@ -104,19 +145,29 @@ def main() -> None:
         jax.block_until_ready(m["loss"])
         fb_times.append(time.perf_counter() - t0)
     fb_peak = peak_bytes()
+    fb_live = live_bytes()
+    fb_rss = host_rss_peak_bytes()
 
     nodes = cfg.batch_size * cfg.max_src_len * args.steps
     result = {
         "config": cfg.name,
         "backend": cfg.backend,
         "compute_dtype": cfg.compute_dtype,
+        "max_src_len": cfg.max_src_len,
+        "noise_mode": cfg.noise_mode,
+        "remat": cfg.remat,
+        "batch": cfg.batch_size,
         "device": str(jax.devices()[0]),
         "fwd_sec_mean": round(sum(fwd_times) / len(fwd_times), 4),
         "fwd_sec_min": round(min(fwd_times), 4),
         "fwd_peak_gb": round(fwd_peak / 2**30, 3),
+        "fwd_live_gb": round(fwd_live / 2**30, 3),
+        "fwd_host_rss_peak_gb": round(fwd_rss / 2**30, 3),
         "fwdbwd_sec_mean": round(sum(fb_times) / len(fb_times), 4),
         "fwdbwd_sec_min": round(min(fb_times), 4),
         "fwdbwd_peak_gb": round(fb_peak / 2**30, 3),
+        "fwdbwd_live_gb": round(fb_live / 2**30, 3),
+        "fwdbwd_host_rss_peak_gb": round(fb_rss / 2**30, 3),
         "fwd_nodes_per_sec": round(nodes / min(fwd_times), 1),
         "fwdbwd_nodes_per_sec": round(nodes / min(fb_times), 1),
     }
