@@ -88,6 +88,13 @@ class Config:
     #             (B,H,N,N) tensor ever reaches HBM — the long-AST memory
     #             lever (the XLA backend materializes the same stream for
     #             differential testing).
+    # Bernoulli clamp floor for the sampled graph: the reference clamps
+    # expA into [0.01, 0.99] (module/STE.py), so every edge keeps a ≥1%
+    # on-probability and an unstructured 128×128 tile is all-zero with
+    # probability ≈e⁻¹⁶⁴ — data-dependent block skipping can never fire.
+    # 0.0 is the flagged quirk-fix (SURVEY §8 policy) that lets the model
+    # learn exact zeros, enabling the flash kernel's tile skip.
+    sbm_floor: float = 0.01
     noise_mode: str = "shared"
     # sequence-parallel attention implementation on a `seq`-sharded mesh:
     # "allgather" — XLA's automatic collectives gather full K/V per device;

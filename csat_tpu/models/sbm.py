@@ -87,6 +87,7 @@ class SBMAttention(nn.Module):
     backend: str = "xla"
     noise_mode: str = "shared"  # "shared" | "counter" (see configs.Config)
     seq_impl: str = "allgather"  # "allgather" | "ring" (see configs.Config)
+    floor: float = 0.01  # Bernoulli clamp floor (cfg.sbm_floor; 0.0 = quirk-fix)
 
     @nn.compact
     def __call__(
@@ -139,6 +140,7 @@ class SBMAttention(nn.Module):
                     out, graph_sums = ring_sbm_attention(
                         q, k, v, q_hat, k_hat, s, key_pad, sample_seed,
                         rate, draw_seed("dropout") if use_dropout else None,
+                        floor=self.floor,
                     )
                     return out, head_sparsity(graph_sums), None, None
             if self.backend == "pallas" and not need_aux:
@@ -147,6 +149,7 @@ class SBMAttention(nn.Module):
                 out, graph_sums = sbm_attention_flash(
                     q, k, v, q_hat, k_hat, s, key_pad, sample_seed,
                     rate, draw_seed("dropout") if use_dropout else None,
+                    floor=self.floor,
                 )
                 return out, head_sparsity(graph_sums), None, None
             from csat_tpu.ops.hashrng import uniform_field
@@ -162,11 +165,12 @@ class SBMAttention(nn.Module):
             out, graph_sums, _ = sbm_attention_fused_pallas(
                 q, k, v, q_hat, k_hat, s, noise, key_pad,
                 rate, draw_seed("dropout") if use_dropout else None,
+                floor=self.floor,
             )
             return out, head_sparsity(graph_sums), None, None
 
         exp_a = jnp.einsum("bhnk,hkj,bhmj->bhnm", q_hat, s, k_hat)
-        graph = sample_graph(exp_a, noise)
+        graph = sample_graph(exp_a, noise, self.floor)
         mask = key_pad[:, None, None, :].astype(bool)
         if self.backend == "pallas":
             from csat_tpu.ops.sbm_pallas import sbm_attention_pallas
@@ -247,6 +251,7 @@ class SBMBlock(nn.Module):
                 backend=cfg.backend,
                 noise_mode=cfg.noise_mode,
                 seq_impl=cfg.seq_impl,
+                floor=cfg.sbm_floor,
             )(q, k, v, key_pad, deterministic, need_aux)
         attn_out = dense(d, self.dtype, name="wo")(merge_heads(attn_out).astype(self.dtype))
         x = x + nn.Dropout(cfg.dropout)(attn_out, deterministic=deterministic)

@@ -13,6 +13,8 @@ construction, which the reference gets only informally.
 
 from __future__ import annotations
 
+import functools
+
 import jax
 import jax.numpy as jnp
 
@@ -24,20 +26,28 @@ def bernoulli_noise(key: jax.Array, shape) -> jnp.ndarray:
     return jax.random.uniform(key, shape, dtype=jnp.float32)
 
 
-@jax.custom_vjp
-def sample_graph(exp_a: jnp.ndarray, noise: jnp.ndarray) -> jnp.ndarray:
-    """A = 1{noise < clamp(expA, .01, .99)} — Bernoulli(p) given uniform noise
-    (ref ``STE.py:10-15``)."""
-    p = jnp.clip(exp_a, 0.01, 0.99)
+@functools.partial(jax.custom_vjp, nondiff_argnums=(2,))
+def sample_graph(
+    exp_a: jnp.ndarray, noise: jnp.ndarray, floor: float = 0.01
+) -> jnp.ndarray:
+    """A = 1{noise < clamp(expA, floor, .99)} — Bernoulli(p) given uniform
+    noise (ref ``STE.py:10-15``).
+
+    ``floor`` defaults to the reference's 0.01 clamp; ``cfg.sbm_floor=0.0``
+    is the flagged quirk-fix that lets the model drive edge probabilities to
+    exactly zero (the precondition for data-dependent block skipping in the
+    flash kernel — ``ops/sbm_flash_pallas.py:24-32``).
+    """
+    p = jnp.clip(exp_a, floor, 0.99)
     return (noise < p).astype(exp_a.dtype)
 
 
-def _fwd(exp_a, noise):
-    a = sample_graph(exp_a, noise)
+def _fwd(exp_a, noise, floor):
+    a = sample_graph(exp_a, noise, floor)
     return a, a
 
 
-def _bwd(a, g):
+def _bwd(floor, a, g):  # noqa: ARG001 — nondiff arg leads per custom_vjp
     # hardtanh(A * grad): gradient flows only through sampled-on entries,
     # clipped to [-1, 1] (ref ``STE.py:17-19``)
     return jnp.clip(a * g, -1.0, 1.0), None

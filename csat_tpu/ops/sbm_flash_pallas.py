@@ -104,7 +104,7 @@ def _keep_scale(dseed, bh, iq, ik, stride, rate):
 
 def _fwd_kernel(
     sseed_ref, dseed_ref, q_ref, k_ref, v_ref, r_ref, kh_ref, pad_ref,
-    out_ref, spars_ref, lse_ref, m_scr, l_scr, acc_scr,
+    out_ref, spars_ref, lse_ref, dead_ref, m_scr, l_scr, acc_scr,
     *, rate: float, n_real: int, stride: int, n_heads: int, floor: float,
 ):
     b, h, iq, ik = (pl.program_id(i) for i in range(4))
@@ -114,6 +114,7 @@ def _fwd_kernel(
     @pl.when((iq == 0) & (ik == 0))
     def _():
         spars_ref[0, 0, 0, 0] = 0.0
+        dead_ref[0, 0, 0, 0] = 0.0
 
     @pl.when(ik == 0)
     def _():
@@ -127,6 +128,10 @@ def _fwd_kernel(
         n_real, stride, floor,
     )
     spars_ref[0, 0, 0, 0] += jnp.sum(a_raw)
+    # dead-tile counter (one scalar add per tile): the measured skip rate of
+    # the block-sparsity bet — @pl.when below skips this tile's matmuls
+    # exactly when the counter increments
+    dead_ref[0, 0, 0, 0] += jnp.where(jnp.sum(a_eff) > 0, 0.0, 1.0)
 
     @pl.when(jnp.sum(a_eff) > 0)
     def _():
@@ -322,7 +327,7 @@ def _fwd_call(q, k, v, r, kh, pad, sseed, dseed, rate, n_real, floor):
         _fwd_kernel, rate=float(rate), n_real=n_real, stride=n_pad,
         n_heads=h, floor=float(floor),
     )
-    out, spars, lse = pl.pallas_call(
+    out, spars, lse, dead = pl.pallas_call(
         kernel,
         grid=(b, h, nq, nk),
         in_specs=[
@@ -331,11 +336,12 @@ def _fwd_call(q, k, v, r, kh, pad, sseed, dseed, rate, n_real, floor):
             cspec(lambda i, j: i), cspec(lambda i, j: j),
             padspec(lambda i, j: j),
         ],
-        out_specs=[qspec(lambda i, j: i), scal, vec(lambda i, j: i)],
+        out_specs=[qspec(lambda i, j: i), scal, vec(lambda i, j: i), scal],
         out_shape=[
             jax.ShapeDtypeStruct((b, h, n_pad, dh), jnp.float32),
             jax.ShapeDtypeStruct((b, h, 1, 1), jnp.float32),
             jax.ShapeDtypeStruct((b, h, n_pad, 1), jnp.float32),
+            jax.ShapeDtypeStruct((b, h, 1, 1), jnp.float32),
         ],
         scratch_shapes=[
             pltpu.VMEM((TILE, 1), jnp.float32),
@@ -345,7 +351,7 @@ def _fwd_call(q, k, v, r, kh, pad, sseed, dseed, rate, n_real, floor):
         cost_estimate=_cost(b, h, nq, nk, dh, fwd=True),
         interpret=_interpret(),
     )(sseed, dseed, q, k, v, r, kh, pad)
-    return out, spars, lse
+    return out, spars, lse, dead
 
 
 def _bwd_call(q, k, v, r, kh, pad, lse, dvec, g_out, gs, sseed, dseed, rate,
@@ -427,8 +433,8 @@ def _flash_fwd_parts(q, k, v, q_hat, k_hat, s_aff, pad, seeds, rate, floor):
                    constant_values=1.0)[:, None, :]  # (B, 1, n_pad)
     sseed = seeds[:1]
     dseed = seeds[1:]
-    out_p, spars, lse = _fwd_call(qp, kp, vp, rp, khp, padp, sseed, dseed,
-                                  rate, n, floor)
+    out_p, spars, lse, _ = _fwd_call(qp, kp, vp, rp, khp, padp, sseed, dseed,
+                                     rate, n, floor)
     spars = spars[:, :, 0, 0]  # (B, H) — SMEM scalars carry unit trailing dims
     return out_p[:, :, :n, :], spars, (out_p, lse, qp, kp, vp, rp, khp, padp)
 
@@ -494,3 +500,39 @@ def sbm_attention_flash(
         q, k, v, q_hat, k_hat, s_aff, key_pad.astype(jnp.float32), seeds,
         float(dropout_rate), float(floor),
     )
+
+
+def flash_tile_stats(
+    q, k, v, q_hat, k_hat, s_aff, key_pad, sample_seed, floor: float = 0.01
+) -> dict:
+    """Measured block-skip diagnostics for one forward pass.
+
+    Runs the forward kernel (same sampling as :func:`sbm_attention_flash`)
+    and returns the in-kernel dead-tile counter: a (q-tile, k-tile) pair is
+    "dead" — its score/value matmuls skipped by ``@pl.when`` — when its
+    sampled ``a_eff`` block is entirely zero. This is the evidence probe for
+    the SURVEY §7.3(3) block-sparsity bet (VERDICT r3 next-round #2).
+    """
+    b, h, n, dh = q.shape
+    kk = q_hat.shape[-1]
+    n_pad = round_up(n, TILE)
+    r = jnp.einsum("bhnk,hkj->bhnj", q_hat, s_aff)
+    qp, kp, vp = (_pad_nodes(x, n_pad) for x in (q, k, v))
+    rp = jnp.pad(r, ((0, 0), (0, 0), (0, n_pad - n), (0, KPAD - kk)))
+    khp = jnp.pad(k_hat, ((0, 0), (0, 0), (0, n_pad - n), (0, KPAD - kk)))
+    padp = jnp.pad(key_pad.astype(jnp.float32), ((0, 0), (0, n_pad - n)),
+                   constant_values=1.0)[:, None, :]
+    seeds = jnp.asarray(sample_seed, jnp.int32).reshape((1,))
+    zero = jnp.zeros((1,), jnp.int32)
+    _, spars, _, dead = _fwd_call(qp, kp, vp, rp, khp, padp, seeds, zero,
+                                  0.0, n, floor)
+    tiles_per_bh = (n_pad // TILE) ** 2
+    dead_total = float(jnp.sum(dead))
+    total = b * h * tiles_per_bh
+    return {
+        "n": n, "n_pad": n_pad, "tile": TILE, "floor": float(floor),
+        "tiles_total": total,
+        "tiles_dead": dead_total,
+        "skip_rate": dead_total / total,
+        "edge_density": float(jnp.sum(spars)) / (b * h * n * n),
+    }

@@ -43,16 +43,17 @@ from jax.experimental.pallas import tpu as pltpu
 from csat_tpu.ops.sbm_pallas import L1_EPS, _attn_chain, _interpret, _keep_mask
 
 
-def _chain(q, k, q_hat, k_hat, s, noise, pad_row):
+def _chain(q, k, q_hat, k_hat, s, noise, pad_row, floor=0.01):
     """Graph sampling + the shared scores/softmax/renorm chain
     (:func:`csat_tpu.ops.sbm_pallas._attn_chain` — single source of truth).
-    Returns (graph, p, attn, z)."""
+    Returns (graph, p, attn, z). ``floor`` is the Bernoulli clamp floor
+    (``cfg.sbm_floor``; the reference's quirk value is 0.01)."""
     exp_a = jnp.dot(
         jnp.dot(q_hat, s, preferred_element_type=jnp.float32),
         k_hat.T,
         preferred_element_type=jnp.float32,
     )
-    graph = (noise < jnp.clip(exp_a, 0.01, 0.99)).astype(jnp.float32)
+    graph = (noise < jnp.clip(exp_a, floor, 0.99)).astype(jnp.float32)
     p, attn, z = _attn_chain(q, k, graph, pad_row)
     return graph, p, attn, z
 
@@ -60,10 +61,12 @@ def _chain(q, k, q_hat, k_hat, s, noise, pad_row):
 def _fwd_kernel(
     seed_ref, q_ref, k_ref, v_ref, qh_ref, kh_ref, s_ref, noise_ref, pad_ref,
     out_ref, spars_ref, attn_ref, *, rate: float, return_attn: bool,
+    floor: float,
 ):
     q, k, v = q_ref[0, 0], k_ref[0, 0], v_ref[0, 0]
     graph, _, attn, _ = _chain(
-        q, k, qh_ref[0, 0], kh_ref[0, 0], s_ref[0], noise_ref[0, 0], pad_ref[0]
+        q, k, qh_ref[0, 0], kh_ref[0, 0], s_ref[0], noise_ref[0, 0],
+        pad_ref[0], floor,
     )
     spars_ref[0, 0, 0, 0] = jnp.sum(graph)
     if return_attn:
@@ -78,7 +81,7 @@ def _fwd_kernel(
 
 def _bwd_kernel(
     seed_ref, q_ref, k_ref, v_ref, qh_ref, kh_ref, s_ref, noise_ref, pad_ref,
-    go_ref, gs_ref, *rest, rate: float, has_ga: bool,
+    go_ref, gs_ref, *rest, rate: float, has_ga: bool, floor: float,
 ):
     # the attn-cotangent input exists only when the forward returned attn —
     # the training path never allocates the (B, H, N, N) zeros tensor
@@ -88,7 +91,8 @@ def _bwd_kernel(
         dq_ref, dk_ref, dv_ref, dqh_ref, dkh_ref, ds_ref = rest
     q, k, v = q_ref[0, 0], k_ref[0, 0], v_ref[0, 0]
     q_hat, k_hat, s = qh_ref[0, 0], kh_ref[0, 0], s_ref[0]
-    graph, p, attn, z = _chain(q, k, q_hat, k_hat, s, noise_ref[0, 0], pad_ref[0])
+    graph, p, attn, z = _chain(
+        q, k, q_hat, k_hat, s, noise_ref[0, 0], pad_ref[0], floor)
     g_out = go_ref[0, 0]
     g_attn_in = ga_ref[0, 0] if has_ga else 0.0
 
@@ -147,16 +151,20 @@ def _specs(b, h, n, dh, kk):
     }
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(9, 10))
-def _fused(q, k, v, q_hat, k_hat, s, noise, pad, seed_arr, rate, return_attn):
-    return _fwd_call(q, k, v, q_hat, k_hat, s, noise, pad, seed_arr, rate, return_attn)
+@functools.partial(jax.custom_vjp, nondiff_argnums=(9, 10, 11))
+def _fused(q, k, v, q_hat, k_hat, s, noise, pad, seed_arr, rate, return_attn,
+           floor=0.01):
+    return _fwd_call(q, k, v, q_hat, k_hat, s, noise, pad, seed_arr, rate,
+                     return_attn, floor)
 
 
-def _fwd_call(q, k, v, q_hat, k_hat, s, noise, pad, seed_arr, rate, return_attn):
+def _fwd_call(q, k, v, q_hat, k_hat, s, noise, pad, seed_arr, rate,
+              return_attn, floor):
     b, h, n, dh = q.shape
     kk = q_hat.shape[-1]
     sp = _specs(b, h, n, dh, kk)
-    kernel = functools.partial(_fwd_kernel, rate=float(rate), return_attn=return_attn)
+    kernel = functools.partial(_fwd_kernel, rate=float(rate),
+                               return_attn=return_attn, floor=float(floor))
     attn_n = n if return_attn else 8  # minimal tile when attn is unused
     out, spars, attn = pl.pallas_call(
         kernel,
@@ -187,19 +195,22 @@ def _fwd_call(q, k, v, q_hat, k_hat, s, noise, pad, seed_arr, rate, return_attn)
     return out, spars, attn
 
 
-def _vjp_fwd(q, k, v, q_hat, k_hat, s, noise, pad, seed_arr, rate, return_attn):
+def _vjp_fwd(q, k, v, q_hat, k_hat, s, noise, pad, seed_arr, rate,
+             return_attn, floor):
     res = (q, k, v, q_hat, k_hat, s, noise, pad, seed_arr)
-    return _fwd_call(q, k, v, q_hat, k_hat, s, noise, pad, seed_arr, rate, return_attn), res
+    return _fwd_call(q, k, v, q_hat, k_hat, s, noise, pad, seed_arr, rate,
+                     return_attn, floor), res
 
 
-def _vjp_bwd(rate, return_attn, res, cots):
+def _vjp_bwd(rate, return_attn, floor, res, cots):
     q, k, v, q_hat, k_hat, s, noise, pad, seed_arr = res
     g_out, g_spars, g_attn = cots
     b, h, n, dh = q.shape
     kk = q_hat.shape[-1]
     has_ga = return_attn and g_attn is not None
     sp = _specs(b, h, n, dh, kk)
-    kernel = functools.partial(_bwd_kernel, rate=float(rate), has_ga=has_ga)
+    kernel = functools.partial(_bwd_kernel, rate=float(rate), has_ga=has_ga,
+                               floor=float(floor))
     in_specs = [
         sp["seed"], sp["qkv"], sp["qkv"], sp["qkv"],
         sp["hat"], sp["hat"], sp["s"], sp["nn"], sp["pad"],
@@ -256,6 +267,7 @@ def sbm_attention_fused_pallas(
     dropout_rate: float = 0.0,
     dropout_seed: Optional[jnp.ndarray] = None,
     return_attn: bool = False,
+    floor: float = 0.01,
 ) -> Tuple[jnp.ndarray, jnp.ndarray, Optional[jnp.ndarray]]:
     """Returns ``(out, graph_sums, attn?)`` — ``graph_sums`` is ``ΣA`` per
     (batch, head); divide by ``B·N·N`` summed over batch for the
@@ -267,5 +279,5 @@ def sbm_attention_fused_pallas(
         seed_arr = jnp.asarray(dropout_seed, dtype=jnp.int32).reshape((1,))
     return _fused(
         q, k, v, q_hat, k_hat, s, noise, pad, seed_arr,
-        float(dropout_rate), bool(return_attn),
+        float(dropout_rate), bool(return_attn), float(floor),
     )

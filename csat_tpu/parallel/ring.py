@@ -78,7 +78,8 @@ def _block_uniform(seed, bh, row0, col0, nl, nk, stride):
 
 
 def _ring_body(
-    q, r, sseed, dseed, bh, row0, nl, p, stride, rate, scale, carry, src,
+    q, r, sseed, dseed, bh, row0, nl, p, stride, rate, scale, floor,
+    carry, src,
 ):
     """One ring step: consume the currently-held K/V block, then rotate.
 
@@ -96,7 +97,7 @@ def _ring_body(
         k_cur, v_cur, kh_cur, pad_cur = blocks
         u = _block_uniform(sseed, bh, row0, col0, nl, nl, stride)
         exp_a = jnp.einsum("bhnj,bhmj->bhnm", r, kh_cur)
-        a_raw = sample_graph(exp_a, u)  # STE custom_vjp (ref STE.py)
+        a_raw = sample_graph(exp_a, u, floor)  # STE custom_vjp (ref STE.py)
         a_eff = a_raw * (1.0 - pad_cur[:, None, None, :])
 
     s_blk = jnp.einsum("bhnd,bhmd->bhnm", q, k_cur) * scale
@@ -120,7 +121,7 @@ def _ring_body(
 
 
 def _ring_local(q, k, v, q_hat, k_hat, s_aff, pad, seeds, *, rate, n, h_total,
-                b_shards, h_shards):
+                b_shards, h_shards, floor=0.01):
     """Per-shard ring computation (runs inside ``shard_map``).
 
     ``q_hat is None`` selects the dense (FullAttention) variant."""
@@ -147,7 +148,7 @@ def _ring_local(q, k, v, q_hat, k_hat, s_aff, pad, seeds, *, rate, n, h_total,
 
     body = partial(
         _ring_body, q, r, seeds[0], seeds[1], bh, row0, nl, p,
-        stride, rate, scale,
+        stride, rate, scale, floor,
     )
     # blocks arrive in source order my, my-1, …  (rotation sends +1 around
     # the ring, so after t hops we hold shard (my - t) mod p's block)
@@ -206,15 +207,18 @@ def ring_sbm_attention(
     sample_seed: jnp.ndarray,
     dropout_rate: float = 0.0,
     dropout_seed: Optional[jnp.ndarray] = None,
+    floor: float = 0.01,
 ) -> Tuple[jnp.ndarray, jnp.ndarray]:
     """Ring-parallel SBM attention over the ambient mesh's ``seq`` axis.
 
     Returns ``(out, graph_sums)`` with the same contract as
     ``sbm_attention_flash`` — ``graph_sums`` is ΣA per (batch, head).
+    ``floor`` is the Bernoulli clamp floor (``cfg.sbm_floor``).
     """
     n, h = q.shape[2], q.shape[1]
     mesh, seeds, sp, kwargs = _ring_setup(
         n, h, sample_seed, dropout_seed, dropout_rate)
+    kwargs["floor"] = float(floor)
     out, graph_sums = jax.shard_map(
         partial(_ring_local, **kwargs),
         mesh=mesh,

@@ -35,12 +35,12 @@ def _inputs(b=2, h=2, n=150, dh=32, kk=5, seed=0):
 
 
 def _xla_mirror(q, k, v, q_hat, k_hat, s_aff, pad, sample_seed,
-                rate=0.0, drop_seed=None):
+                rate=0.0, drop_seed=None, floor=0.01):
     """Reference composition with the materialized hash-noise field."""
     b, h, n, dh = q.shape
     noise = uniform_field(sample_seed, b, h, n, n, round_up(n, TILE))
     exp_a = jnp.einsum("bhnk,hkj,bhmj->bhnm", q_hat, s_aff, k_hat)
-    graph = sample_graph(exp_a, noise)
+    graph = sample_graph(exp_a, noise, floor)
     mask = pad[:, None, None, :].astype(bool)
     dot = jnp.einsum("bhnd,bhmd->bhnm", q, k) / math.sqrt(dh)
     dot = jnp.where(mask, -jnp.inf, dot)
@@ -185,3 +185,81 @@ def test_model_counter_train_step(tiny_config, synthetic_corpus):
     after = np.asarray(
         state.params["encoder"]["transformer_0"]["SBMAttention_0"]["clusters"])
     assert not np.array_equal(before, after)
+
+
+def test_flash_floor_zero_matches_mirror_and_skips_tiles():
+    """The sbm_floor=0.0 quirk-fix: parity holds between the flash kernel
+    and the XLA mirror at floor 0, and structurally-dead cluster blocks
+    actually register on the in-kernel dead-tile counter."""
+    from csat_tpu.ops.sbm_flash_pallas import flash_tile_stats
+
+    b, h, n, dh, kk = 1, 2, 256, 16, 4
+    q, k, v, q_hat, k_hat, s_aff, pad = _inputs(b=b, h=h, n=n, dh=dh, kk=kk)
+    # drive the second k-tile's memberships to exact zero: with floor=0.0
+    # every (q-tile, tile-1) pair samples an all-dead block
+    k_hat = k_hat.at[:, :, 128:, :].set(0.0)
+
+    out_p, gs_p = sbm_attention_flash(
+        q, k, v, q_hat, k_hat, s_aff, pad, SEED, floor=0.0)
+    out_x, gs_x = _xla_mirror(
+        q, k, v, q_hat, k_hat, s_aff, pad, SEED, floor=0.0)
+    np.testing.assert_array_equal(np.asarray(gs_p), np.asarray(gs_x))
+    np.testing.assert_allclose(np.asarray(out_p), np.asarray(out_x), atol=2e-5)
+
+    stats = flash_tile_stats(q, k, v, q_hat, k_hat, s_aff, pad, SEED, floor=0.0)
+    # 2x2 tiles per (b,h): the (*, 1) column is dead => skip rate >= 1/2
+    assert stats["tiles_total"] == b * h * 4
+    assert stats["skip_rate"] >= 0.5, stats
+    # at the reference floor the same inputs keep every tile alive (the
+    # 1% Bernoulli floor resurrects the zeroed blocks)
+    stats_ref = flash_tile_stats(
+        q, k, v, q_hat, k_hat, s_aff, pad, SEED, floor=0.01)
+    assert stats_ref["tiles_dead"] == 0, stats_ref
+    assert stats_ref["edge_density"] > stats["edge_density"]
+
+
+def test_flash_floor_zero_grads_match_mirror():
+    q, k, v, q_hat, k_hat, s_aff, pad = _inputs(b=1, h=2, n=140, dh=16, kk=4)
+    k_hat = k_hat.at[:, :, 64:, :].set(0.0)
+    go = jax.random.normal(jax.random.key(3), q.shape)
+
+    def loss(fn, *xs):
+        out, gs = fn(*xs)
+        return jnp.sum(out * go) + 1e-3 * jnp.sum(gs)
+
+    f_p = lambda qh, kh: loss(
+        lambda *a: sbm_attention_flash(q, k, v, *a, s_aff, pad, SEED, floor=0.0),
+        qh, kh)
+    f_x = lambda qh, kh: loss(
+        lambda *a: _xla_mirror(q, k, v, *a, s_aff, pad, SEED, floor=0.0),
+        qh, kh)
+    gp = jax.grad(f_p, argnums=(0, 1))(q_hat, k_hat)
+    gx = jax.grad(f_x, argnums=(0, 1))(q_hat, k_hat)
+    for a, b, name in zip(gp, gx, ("q_hat", "k_hat")):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), atol=3e-5, err_msg=name)
+
+
+def test_model_floor_config_plumbed(tiny_config):
+    """cfg.sbm_floor reaches the sampled graph: at floor=0.0 a model whose
+    memberships collapse toward zero produces a sparser graph than at the
+    reference 0.01 floor (same params, same noise)."""
+    import dataclasses
+
+    from csat_tpu.data.toy import random_batch
+    from csat_tpu.train.state import make_model
+
+    cfg0 = dataclasses.replace(tiny_config, noise_mode="counter")
+    batch = random_batch(cfg0, 2, 97, 83, 31, seed=0)
+    model0 = make_model(cfg0, 97, 83, 31)
+    variables = model0.init(
+        {"params": jax.random.key(0), "sample": jax.random.key(1)}, batch)
+    cfg1 = dataclasses.replace(cfg0, sbm_floor=0.0)
+    model1 = make_model(cfg1, 97, 83, 31)
+    _, s0, *_ = model0.apply(
+        {"params": variables["params"]}, batch, rngs={"sample": jax.random.key(2)})
+    _, s1, *_ = model1.apply(
+        {"params": variables["params"]}, batch, rngs={"sample": jax.random.key(2)})
+    # identical counter stream; lifting the floor can only remove edges
+    assert float(s1) <= float(s0)
+    assert np.isfinite(float(s1))

@@ -46,6 +46,10 @@ def main() -> None:
             flags + " --xla_force_host_platform_device_count=8").strip()
 
     import jax
+
+    # the axon plugin ignores the env var; only the config update reliably
+    # keeps this CPU-mesh check off the (possibly wedged) TPU relay
+    jax.config.update("jax_platforms", "cpu")
     import jax.numpy as jnp
     import numpy as np
     from jax.sharding import NamedSharding, PartitionSpec as P
