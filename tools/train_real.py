@@ -46,6 +46,19 @@ def main() -> None:
                    help="train at the reference config's full dims "
                         "(512-wide, 4+4 layers — TPU-sized) instead of the "
                         "CPU-budget 128-wide 2+2 stack")
+    p.add_argument("--config", default="",
+                   help="base config name override (e.g. python_seq for the "
+                        "sequential-PE variant); default derives from "
+                        "--variant")
+    p.add_argument("--compute_dtype", default="",
+                   choices=["", "float32", "bfloat16"],
+                   help="activation dtype override (bf16 = the MXU path)")
+    p.add_argument("--floor", default="",
+                   help="sbm_floor override (e.g. 0.0 lifts the reference's "
+                        "0.01 Bernoulli clamp — the block-sparsity quirk-fix)")
+    p.add_argument("--tag", default="",
+                   help="suffix for the task/output dir (keeps ablation runs "
+                        "from clobbering each other)")
     args = p.parse_args()
 
     os.environ["JAX_PLATFORMS"] = args.platform
@@ -61,7 +74,8 @@ def main() -> None:
     from csat_tpu.data.dataset import ASTDataset
     from csat_tpu.train import Trainer, run_test
 
-    name = "python_full_att" if args.variant == "full_att" else "python"
+    name = args.config or (
+        "python_full_att" if args.variant == "full_att" else "python")
     dims = {} if args.full_dims else dict(
         pe_dim=64,
         pegen_dim=128,
@@ -76,10 +90,15 @@ def main() -> None:
     )
     if args.backend:
         dims["backend"] = args.backend
+    if args.compute_dtype:
+        dims["compute_dtype"] = args.compute_dtype
+    if args.floor:
+        dims["sbm_floor"] = float(args.floor)
+    tag = f"_{args.tag}" if args.tag else ""
     cfg = get_config(
         name,
         data_dir=args.data_dir,
-        task_name=f"real_stdlib_{args.variant}",
+        task_name=f"real_stdlib_{args.variant}{tag}",
         batch_size=args.batch_size,
         num_epochs=args.epochs,
         learning_rate=args.learning_rate,
