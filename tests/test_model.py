@@ -170,3 +170,26 @@ def test_all_pe_variants_train_step(tiny_config):
         step = make_train_step(model, tx, cfg)
         state, metrics = step(state, batch)
         assert np.isfinite(float(metrics["loss"])), variant
+
+
+def test_triplet_fallback_rejects_oversized_dictionary(tmp_path, tiny_config):
+    """make_model with the fallback triplet sizing must refuse a corpus
+    whose on-disk dictionary is larger than the fallback table — jnp.take's
+    clip semantics would otherwise silently corrupt lookups (VERDICT r3
+    weak #8)."""
+    import pytest
+
+    from csat_tpu.data.vocab import Vocab
+    from csat_tpu.models.csa_trans import TRIPLET_VOCAB_FALLBACK
+    from csat_tpu.train.state import make_model
+
+    cfg = tiny_config.replace(use_pegen="triplet", data_dir=str(tmp_path))
+    big = Vocab(need_bos=False)
+    fallback = TRIPLET_VOCAB_FALLBACK[cfg.lang]
+    for i in range(fallback + 10):
+        big.add(f"(1, {i}, {i})")
+    big.save(str(tmp_path / f"node_triplet_dictionary_{cfg.lang}.pt"))
+    with pytest.raises(ValueError, match="triplet dictionary"):
+        make_model(cfg, 97, 83, 0)
+    # explicit sizing is always accepted
+    make_model(cfg, 97, 83, big.size())
