@@ -59,6 +59,9 @@ def main() -> None:
     p.add_argument("--tag", default="",
                    help="suffix for the task/output dir (keeps ablation runs "
                         "from clobbering each other)")
+    p.add_argument("--num_heads", type=int, default=0,
+                   help="override head count (8 pairs with the torch "
+                        "reference baseline, whose CSE hard-tiles 4+4 heads)")
     args = p.parse_args()
 
     os.environ["JAX_PLATFORMS"] = args.platform
@@ -90,6 +93,15 @@ def main() -> None:
     )
     if args.backend:
         dims["backend"] = args.backend
+    if args.num_heads:
+        dims["num_heads"] = args.num_heads
+    if args.config:
+        from csat_tpu.configs import get_config as _gc
+
+        base = _gc(args.config)
+        if base.pe_dim == 0:  # sequential PE: no pegen stack to size
+            dims.pop("pe_dim", None)
+            dims.pop("pegen_dim", None)
     if args.compute_dtype:
         dims["compute_dtype"] = args.compute_dtype
     if args.floor:
