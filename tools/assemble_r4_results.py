@@ -28,16 +28,16 @@ RUNS = {
     "full_att f32 (r3, 4 heads)": [
         "results/real_stdlib/full_att/summary.json"],
     "sbm f32 floor=0.0 (4 heads)": [
-        "outputs/r4/stdlib_python/real_stdlib_sbm_floor0/summary.json",
+        "outputs/r4/final_exp/real_stdlib_sbm_floor0/summary.json",
         "results/real_stdlib/sbm_floor0/summary.json"],
     "sbm bf16 floor=0.01 (4 heads)": [
-        "outputs/r4/stdlib_python/real_stdlib_sbm_bf16/summary.json",
+        "outputs/r4/final_exp/real_stdlib_sbm_bf16/summary.json",
         "results/real_stdlib/sbm_bf16/summary.json"],
     "sbm f32 (8 heads, torch pair)": [
-        "outputs/r4/stdlib_python/real_stdlib_sbm_h8/summary.json",
+        "outputs/r4/final_exp/real_stdlib_sbm_h8/summary.json",
         "results/real_stdlib/sbm_h8/summary.json"],
     "sequential-PE f32 (8 heads)": [
-        "outputs/r4/stdlib_python/real_stdlib_sbm_seq_h8/summary.json",
+        "outputs/r4/final_exp/real_stdlib_sbm_seq_h8/summary.json",
         "results/real_stdlib/seq_h8/summary.json"],
     "torch reference (8 heads)": [
         "results/real_stdlib_torch/summary.json"],

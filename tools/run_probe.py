@@ -31,6 +31,11 @@ def main() -> None:
     ap.add_argument("--checkpoint_dir", default=None)
     ap.add_argument("--hops", type=int, nargs="+", default=[3, 5, 7])
     ap.add_argument("--max_samples", type=int, default=256)
+    ap.add_argument("--override", action="append", default=[],
+                    help="config override key=value (repeatable) — must match "
+                         "the dims the checkpoint was trained with, e.g. "
+                         "--override hidden_size=128 --override num_heads=8")
+    ap.add_argument("--out", default="", help="optional JSON output path")
     args = ap.parse_args()
 
     from csat_tpu.configs import get_config
@@ -40,9 +45,17 @@ def main() -> None:
     from csat_tpu.train.checkpoint import restore_params
     from csat_tpu.train.state import create_train_state, default_optimizer, make_model
 
+    import ast as _ast
+
     overrides = {}
     if args.data_dir:
         overrides["data_dir"] = args.data_dir
+    for kv in args.override:
+        k, v = kv.split("=", 1)
+        try:
+            overrides[k] = _ast.literal_eval(v)
+        except (ValueError, SyntaxError):
+            overrides[k] = v
     cfg = get_config(args.config, **overrides)
     src_vocab, tgt_vocab = load_vocab(cfg.data_dir)
     ds = ASTDataset(cfg, args.split, src_vocab, tgt_vocab)
@@ -80,7 +93,14 @@ def main() -> None:
         run_probe(pes_arr, parents, n_nodes, types, hops=h, epochs=100)
         for h in args.hops
     ]
-    print(json.dumps({"config": cfg.name, "split": args.split, "probe": results}))
+    report = {"config": cfg.name, "split": args.split,
+              "checkpoint": args.checkpoint_dir, "overrides": overrides,
+              "probe": results}
+    if args.out:
+        os.makedirs(os.path.dirname(args.out) or ".", exist_ok=True)
+        with open(args.out, "w") as f:
+            json.dump(report, f, indent=1)
+    print(json.dumps(report))
 
 
 if __name__ == "__main__":
