@@ -36,7 +36,15 @@ def main() -> None:
                          "the dims the checkpoint was trained with, e.g. "
                          "--override hidden_size=128 --override num_heads=8")
     ap.add_argument("--out", default="", help="optional JSON output path")
+    ap.add_argument("--platform", default="cpu",
+                    help="jax platform (default cpu — the probe is cheap and "
+                         "must not touch a wedged TPU relay; pass '' to use "
+                         "the ambient backend)")
     args = ap.parse_args()
+    if args.platform:
+        # the axon plugin ignores the env var; the config update is the
+        # reliable off-switch (jax imported at module top)
+        jax.config.update("jax_platforms", args.platform)
 
     from csat_tpu.configs import get_config
     from csat_tpu.data.dataset import ASTDataset, iterate_batches, load_matrices
