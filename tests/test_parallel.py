@@ -5,9 +5,7 @@ import numpy as np
 import pytest
 
 from csat_tpu.data.dataset import ASTDataset, iterate_batches
-from csat_tpu.parallel.dryrun import dryrun_train_step
 from csat_tpu.parallel.mesh import build_mesh, param_sharding, PARAM_RULES
-from jax.sharding import PartitionSpec as P
 
 
 def test_eight_devices_available():
