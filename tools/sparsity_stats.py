@@ -1,0 +1,138 @@
+"""Measured tile-skip rate of a TRAINED model (VERDICT r3 next-round #2).
+
+The flash kernel skips a (q-tile, k-tile) block's matmuls when its sampled
+graph block is all-zero. The synthetic test (`tests/test_flash_ops.py`)
+proves the mechanism; this tool measures whether a REAL trained model's
+memberships actually produce dead tiles — the datum the ≥4× bet rides on.
+
+Loads a checkpoint, runs the XLA aux forward (which returns the sampled
+graphs — bit-comparable to the kernel's in-kernel sampling) over real test
+batches, and reports per-layer tile deadness at the checkpoint's training
+floor AND at the reference floor for contrast (same params; the floor only
+changes the Bernoulli clamp).
+
+    python tools/sparsity_stats.py \
+        --checkpoint_dir outputs/r4/final_exp/real_stdlib_sbm_floor0 \
+        --data_dir ./data/stdlib_python --out results/perf/tile_skip_r4.json
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import jax.numpy as jnp  # noqa: E402
+import numpy as np  # noqa: E402
+
+TILE = 128
+
+
+def tile_deadness(graph: np.ndarray, pad: np.ndarray, tile: int = TILE) -> tuple:
+    """(dead_tiles, total_tiles) over tile-aligned blocks of a (B,H,N,N)
+    sampled graph; padded keys cannot carry mass (the kernel's a_eff)."""
+    b, h, n, _ = graph.shape
+    eff = graph * (1.0 - pad[:, None, None, :])
+    n_pad = ((n + tile - 1) // tile) * tile
+    gpad = np.zeros((b, h, n_pad, n_pad), graph.dtype)
+    gpad[:, :, :n, :n] = eff
+    t = n_pad // tile
+    blocks = gpad.reshape(b, h, t, tile, t, tile).sum(axis=(3, 5))
+    dead = int((blocks == 0).sum())
+    return dead, b * h * t * t
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--checkpoint_dir", required=True)
+    ap.add_argument("--data_dir", required=True)
+    ap.add_argument("--batches", type=int, default=2)
+    ap.add_argument("--batch_size", type=int, default=16)
+    ap.add_argument("--out", default="")
+    ap.add_argument("--override", action="append", default=[])
+    args = ap.parse_args()
+
+    import ast as _ast
+
+    from csat_tpu.configs import get_config
+    from csat_tpu.data.dataset import ASTDataset, iterate_batches
+    from csat_tpu.data.vocab import load_vocab
+    from csat_tpu.train.checkpoint import restore_params
+    from csat_tpu.train.state import make_model
+
+    overrides = {
+        "data_dir": args.data_dir, "batch_size": args.batch_size,
+        # train_real CPU dims — override via --override for other runs
+        "pe_dim": 64, "pegen_dim": 128, "sbm_enc_dim": 128,
+        "hidden_size": 128, "num_heads": 4, "num_layers": 2,
+        "sbm_layers": 2, "clusters": (8, 8), "dim_feed_forward": 512,
+        "max_tgt_len": 30,
+    }
+    for kv in args.override:
+        k, v = kv.split("=", 1)
+        try:
+            overrides[k] = _ast.literal_eval(v)
+        except (ValueError, SyntaxError):
+            overrides[k] = v
+
+    sv, tv = load_vocab(args.data_dir)
+    params = restore_params(args.checkpoint_dir)
+
+    report = {"checkpoint": args.checkpoint_dir, "floors": {}}
+    for floor in (0.0, 0.01):
+        cfg = get_config("python", sbm_floor=floor, **overrides)
+        model = make_model(cfg, sv.size(), tv.size())
+        ds = ASTDataset(cfg, "test", sv, tv)
+        dead_by_layer, total_by_layer, density = None, None, []
+        finer = {32: [0, 0], 64: [0, 0]}  # skip headroom at smaller tiles
+        key = jax.random.key(0)
+        for bi, batch in enumerate(
+                iterate_batches(ds, cfg.batch_size, shuffle=False)):
+            if bi >= args.batches:
+                break
+            key, sub = jax.random.split(key)
+            _, _, _, graphs, _ = model.apply(
+                {"params": params}, batch, deterministic=True,
+                collect_aux=True, rngs={"sample": sub})
+            pad = np.asarray(batch.src_seq == 0, np.float32)
+            if dead_by_layer is None:
+                dead_by_layer = [0] * len(graphs)
+                total_by_layer = [0] * len(graphs)
+            for li, g in enumerate(graphs):
+                g = np.asarray(g, np.float32)
+                d, t = tile_deadness(g, pad)
+                dead_by_layer[li] += d
+                total_by_layer[li] += t
+                density.append(float(g.mean()))
+                for ft in finer:
+                    fd, ftt = tile_deadness(g, pad, ft)
+                    finer[ft][0] += fd
+                    finer[ft][1] += ftt
+        report["floors"][str(floor)] = {
+            "dead_tiles_by_layer": dead_by_layer,
+            "total_tiles_by_layer": total_by_layer,
+            "skip_rate_by_layer": [
+                round(d / t, 4) for d, t in zip(dead_by_layer, total_by_layer)],
+            "skip_rate_overall": round(
+                sum(dead_by_layer) / sum(total_by_layer), 4),
+            "mean_edge_density": round(float(np.mean(density)), 4),
+            "skip_rate_tile32": round(finer[32][0] / finer[32][1], 4),
+            "skip_rate_tile64": round(finer[64][0] / finer[64][1], 4),
+        }
+
+    print(json.dumps(report))
+    if args.out:
+        os.makedirs(os.path.dirname(args.out) or ".", exist_ok=True)
+        with open(args.out, "w") as f:
+            json.dump(report, f, indent=1)
+
+
+if __name__ == "__main__":
+    main()
