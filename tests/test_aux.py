@@ -169,3 +169,25 @@ class TestEntryProbeCache:
         ge._write_cached_verdict(True)
         ge._device_backend_or_cpu()
         assert ge._PROBE_ALIVE is True
+
+
+def test_tile_deadness_counts():
+    """tools/sparsity_stats.tile_deadness: exact block accounting incl.
+    pad-column zeroing and ragged-N padding."""
+    import sys
+
+    sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", "tools"))
+    from sparsity_stats import tile_deadness
+
+    b, h, n = 1, 1, 6
+    graph = np.zeros((b, h, n, n), np.float32)
+    graph[0, 0, 0, 1] = 1.0  # one live edge in the top-left 4x4 block
+    graph[0, 0, 5, 5] = 1.0  # live edge in the bottom-right block...
+    pad = np.zeros((b, n), np.float32)
+    pad[0, 5] = 1.0  # ...but key 5 is padded -> block dead
+    # tile=4 on n=6 -> padded to 8 -> 2x2 blocks
+    dead, total = tile_deadness(graph, pad, tile=4)
+    assert (dead, total) == (3, 4)
+    # without the pad the bottom-right block is alive
+    dead2, _ = tile_deadness(graph, np.zeros((b, n), np.float32), tile=4)
+    assert dead2 == 2
