@@ -171,12 +171,11 @@ class TestEntryProbeCache:
         assert ge._PROBE_ALIVE is True
 
 
-def test_tile_deadness_counts():
+def test_tile_deadness_counts(monkeypatch):
     """tools/sparsity_stats.tile_deadness: exact block accounting incl.
     pad-column zeroing and ragged-N padding."""
-    import sys
-
-    sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", "tools"))
+    monkeypatch.syspath_prepend(
+        os.path.join(os.path.dirname(__file__), "..", "tools"))
     from sparsity_stats import tile_deadness
 
     b, h, n = 1, 1, 6
