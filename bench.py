@@ -151,6 +151,11 @@ def _measure_one(spec: str) -> dict:
 
     n_chips = jax.device_count()
     nodes = cfg.batch_size * cfg.max_src_len * n_steps
+    try:  # peak HBM (VERDICT r3 #1); CPU backends expose no stats → 0
+        peak = int((jax.devices()[0].memory_stats() or {})
+                   .get("peak_bytes_in_use", 0))
+    except Exception:
+        peak = 0
     return {
         "ok": True,
         "backend": backend,
@@ -161,6 +166,7 @@ def _measure_one(spec: str) -> dict:
         "compile_s": round(t_compile, 1),
         "steps": n_steps,
         "step_ms": round(dt / n_steps * 1e3, 2),
+        "peak_hbm_gb": round(peak / 2**30, 3),
         "nodes_per_sec_per_chip": nodes / dt / n_chips,
     }
 
@@ -451,7 +457,8 @@ def main() -> None:
             out["notes"] = "; ".join(notes)
         out["all_variants"] = [
             {k: r[k] for k in ("backend", "dtype", "device", "step_ms",
-                               "nodes_per_sec_per_chip")}
+                               "peak_hbm_gb", "nodes_per_sec_per_chip")
+             if k in r}
             for r in results
         ]
         for r in results:
