@@ -1,0 +1,54 @@
+#!/bin/bash
+# TPU-recovery measurement sequence (run the moment `bench.py --probe`
+# answers — the first healthy window may be the only one; see
+# results/perf/tpu_session_r3.md for the claim rules this encodes).
+#
+# One chip claim per child, clean exits, warm .jax_cache between stages.
+# Usage:  bash tools/tpu_recovery.sh [results_dir]
+set -u
+cd "$(dirname "$0")/.."
+OUT=${1:-results/perf}
+mkdir -p "$OUT"
+STAMP=$(date -u +%Y%m%dT%H%M%SZ)
+LOG="$OUT/tpu_recovery_$STAMP.log"
+say() { echo "[$(date -u +%T)] $*" | tee -a "$LOG"; }
+
+say "probe"
+timeout 150 python bench.py --probe >> "$LOG" 2>&1 || { say "probe dead rc=$?"; exit 1; }
+
+# 1. bench variants, proven-first, ONE serve child per variant so an
+#    overrun never takes later variants down with it (soft budget 900 s,
+#    first compiles can exceed 600 s through the remote compiler)
+for SPEC in pallas:float32:default:64:20 xla:float32:default:64:20 \
+            xla:bfloat16:default:64:20 pallas:bfloat16:default:64:20; do
+  say "serve $SPEC"
+  timeout 1100 python bench.py --serve "$SPEC" 900 >> "$LOG" 2>&1
+  say "serve $SPEC rc=$? (results in .bench_results.jsonl)"
+  timeout 150 python bench.py --probe >> "$LOG" 2>&1 || { say "relay died after $SPEC"; break; }
+done
+cp -f .bench_results.jsonl "$OUT/bench_results_tpu_$STAMP.jsonl" 2>/dev/null
+
+# 2. time/memory matrix on-chip (real peak HBM per N/remat/kernel combo)
+say "memory matrix (tpu)"
+timeout 5400 python tools/memory_matrix.py --device tpu \
+  --out "$OUT/memory_matrix_tpu_$STAMP.jsonl" >> "$LOG" 2>&1
+say "memory matrix rc=$?"
+
+# 3. pallas-vs-xla step time at the sparsity floors (the block-skip bet)
+for ARGS in "--backend pallas --noise_mode counter" \
+            "--backend xla --noise_mode counter"; do
+  for FLOOR_CFG in "" "--max_src_len 512"; do
+    say "time_memory $ARGS $FLOOR_CFG"
+    timeout 1500 python tools/time_memory.py --config python $ARGS $FLOOR_CFG \
+      --batch 64 --reps 5 --steps 4 >> "$LOG" 2>&1
+  done
+done
+
+# 4. full-dims real-data training on the chip (background; runs as long as
+#    the window lasts — resume-capable via orbax)
+say "launching full-dims train_real on axon"
+nohup python tools/train_real.py --data_dir ./data/stdlib_python \
+  --variant sbm --full_dims --backend pallas --platform axon \
+  --epochs 40 --val_interval 5 --out ./outputs/real_stdlib_tpu \
+  > "$OUT/train_tpu_$STAMP.log" 2>&1 &
+say "done (train pid $!)"
