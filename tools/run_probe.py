@@ -77,12 +77,26 @@ def main() -> None:
     if args.checkpoint_dir:
         params = restore_params(args.checkpoint_dir)
 
+    sequential = cfg.use_pegen == "sequential"
+    if sequential:
+        # the sequential variant has no learned probe-visible PE; the
+        # reference probes the raw sinusoidal encoding directly
+        # (ref inp_py.py:464 comment + :618-722 section)
+        from csat_tpu.models.components import sinusoidal_table
+
+        sin_pe = np.asarray(
+            sinusoidal_table(cfg.max_src_len, cfg.sbm_enc_dim))
+
     pes, parents, n_nodes, types = [], [], [], []
     key = jax.random.key(0)
     seen = 0
     for batch in iterate_batches(ds, cfg.batch_size, shuffle=False, drop_last=False):
         key, sub = jax.random.split(key)
-        pe = extract_pe(model, params, batch, sub)  # (B, N, pe_dim)
+        if sequential:
+            pe = np.broadcast_to(
+                sin_pe[None], (batch.src_seq.shape[0], *sin_pe.shape))
+        else:
+            pe = extract_pe(model, params, batch, sub)  # (B, N, pe_dim)
         for b in range(pe.shape[0]):
             if seen >= min(args.max_samples, len(records)):
                 break
