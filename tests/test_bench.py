@@ -206,3 +206,27 @@ def test_vs_baseline_ratio(bench, monkeypatch, tmp_path, capsys):
     out = _run_main(bench, capsys)
     assert out["vs_baseline"] == 4.5
     assert out["baseline_device"] == "cpu"
+
+
+def test_cpu_ratio_uses_same_batch_baseline(bench, monkeypatch, tmp_path, capsys):
+    """When the torch sweep recorded the winning CPU spec's batch, the
+    ratio must compare same-batch numbers, not the sweep headline."""
+    with open(tmp_path / "baseline_torch.json", "w") as f:
+        json.dump({"ast_nodes_per_sec_per_chip": 306.1, "device": "cpu",
+                   "batch": 6, "by_batch": {"6": 306.1, "64": 252.6}}, f)
+
+    def fake_child(args, timeout_s):
+        if args[0] == "--probe":
+            return None, "timeout after 120s"
+        for spec in args[1].split(","):
+            _emit(bench, {"phase": "start", "spec": spec})
+            if not spec.startswith("pallas"):
+                _emit(bench, _result(spec, 200.0 if "float32" in spec else 100.0))
+        _emit(bench, {"phase": "done"})
+        return {"ok": True, "phase": "done"}, None
+
+    monkeypatch.setattr(bench, "_run_child", fake_child)
+    out = _run_main(bench, capsys)
+    assert out["value"] == 200.0
+    assert out["baseline_batch"] == 6  # winning spec is batch 6
+    assert out["vs_baseline"] == round(200.0 / 306.1, 3)
