@@ -441,10 +441,12 @@ def main() -> None:
         pool = real or results
         best = max(pool, key=lambda r: r["nodes_per_sec_per_chip"])
         value = best["nodes_per_sec_per_chip"]
-        # same-batch fairness on CPU: when the torch sweep recorded this
-        # spec's batch, compare against THAT number, not the headline
-        if best["device"] == "cpu" and base.get("by_batch"):
-            spec_batch = str(best.get("spec", "::::0").split(":")[3])
+        # same-batch fairness: when the torch sweep recorded this spec's
+        # batch, compare against THAT number, not the sweep headline —
+        # applies on every device (a batch-64 TPU win compares to torch's
+        # batch-64 protocol number, a batch-6 CPU win to torch's batch-6)
+        if base.get("by_batch") and "spec" in best:
+            spec_batch = best["spec"].split(":")[3]
             same = base["by_batch"].get(spec_batch)
             if same:
                 baseline = float(same)

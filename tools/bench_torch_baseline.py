@@ -150,18 +150,12 @@ class Baseline(nn.Module):
         return F.log_softmax(self.gen(out), -1), torch.stack(sparsities).mean()
 
 
-def main() -> None:
-    ap = argparse.ArgumentParser()
-    ap.add_argument("--steps", type=int, default=5)
-    ap.add_argument("--batch", type=int, default=BATCH)
-    args = ap.parse_args()
-
-    dev = "cuda" if torch.cuda.is_available() else "cpu"
+def _measure(batch: int, steps: int, dev: str) -> tuple:
     torch.manual_seed(0)
     model = Baseline().to(dev)
     opt = torch.optim.AdamW(model.parameters(), lr=1e-4, eps=1e-6)
 
-    b = args.batch
+    b = batch
     src = torch.randint(4, SRC_V, (b, MAX_SRC), device=dev)
     tgt = torch.randint(4, TGT_V, (b, MAX_TGT), device=dev)
     rel = torch.randint(0, MAX_SRC, (b, HEADS, MAX_SRC, MAX_SRC), device=dev)
@@ -180,20 +174,42 @@ def main() -> None:
     if dev == "cuda":
         torch.cuda.synchronize()
     t0 = time.perf_counter()
-    for _ in range(args.steps):
+    for _ in range(steps):
         loss = step()
     if dev == "cuda":
         torch.cuda.synchronize()
     dt = time.perf_counter() - t0
-    nodes_per_sec = b * MAX_SRC * args.steps / dt
+    return b * MAX_SRC * steps / dt, float(loss)
 
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--steps", type=int, default=5)
+    ap.add_argument("--batch", type=int, default=BATCH)
+    ap.add_argument("--sweep", type=int, nargs="*", default=None,
+                    help="measure several batch sizes and write the full "
+                        "by_batch table (bench.py's same-batch ratio needs "
+                        "it); headline = the best; e.g. --sweep 6 8 16 64")
+    args = ap.parse_args()
+
+    dev = "cuda" if torch.cuda.is_available() else "cpu"
+    batches = args.sweep if args.sweep else [args.batch]
+    by_batch, loss = {}, 0.0
+    for b in batches:
+        nodes, loss = _measure(b, args.steps, dev)
+        by_batch[str(b)] = round(nodes, 1)
+
+    best_b = max(by_batch, key=lambda k: by_batch[k])
     result = {
-        "ast_nodes_per_sec_per_chip": round(nodes_per_sec, 1),
+        "ast_nodes_per_sec_per_chip": by_batch[best_b],
         "device": dev,
         "torch": torch.__version__,
         "steps": args.steps,
-        "batch": b,
-        "loss": float(loss),
+        "batch": int(best_b),
+        "note": "headline = best over the sweep; bench.py compares "
+                "same-batch numbers via by_batch",
+        "by_batch": by_batch,
+        "loss": loss,
     }
     path = os.path.join(os.path.dirname(os.path.abspath(__file__)), "..", "baseline_torch.json")
     with open(os.path.abspath(path), "w") as f:
