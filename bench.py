@@ -279,11 +279,11 @@ def _run_child(args, timeout_s: float):
     return None, "no result line in child output"
 
 
-def _read_results() -> tuple[list, list]:
+def _read_results(path: str = "") -> tuple[list, list]:
     """(measurements, phase-notes) accumulated by the serve child."""
     results, phases = [], []
     try:
-        with open(RESULTS_PATH) as f:
+        with open(path or RESULTS_PATH) as f:
             for line in f:
                 try:
                     rec = json.loads(line)
@@ -414,6 +414,46 @@ def main() -> None:
         notes.append(f"killed during {dead[-1]}")
 
     degraded = not any(r["device"] != "cpu" for r in results)
+
+    # When THIS run cannot produce a device number but an earlier session in
+    # the same working tree archived on-chip results (tools/tpu_recovery.sh
+    # copies the serve JSONL to results/perf/bench_results_tpu_*.jsonl), embed
+    # them with provenance. The headline value stays honestly CPU-measured +
+    # degraded; the session block carries the chip evidence and its capture
+    # time so a later wedge cannot erase a healthy window's measurements.
+    tpu_session = None
+    if degraded:
+        try:
+            import glob
+
+            archived = sorted(glob.glob(
+                os.path.join(HERE, "results", "perf", "bench_results_tpu_*.jsonl")))
+            # newest-first, falling back past archives whose recovery attempt
+            # recorded no usable device result (e.g. every serve child died
+            # during a wedge) so a failed retry cannot erase a healthy window
+            for cand in reversed(archived):
+                sess = [
+                    {k: rec[k] for k in (
+                        "spec", "backend", "dtype", "device", "step_ms",
+                        "peak_hbm_gb", "nodes_per_sec_per_chip",
+                        "compile_s") if k in rec}
+                    for rec in _read_results(cand)[0]
+                    if rec.get("device") != "cpu"
+                ]
+                if sess:
+                    tpu_session = {
+                        "source": os.path.relpath(cand, HERE),
+                        "captured_at_utc": time.strftime(
+                            "%Y-%m-%dT%H:%M:%SZ",
+                            time.gmtime(os.path.getmtime(cand))),
+                        "note": "on-chip results from an earlier healthy-relay "
+                                "window this round; NOT measured by this "
+                                "invocation",
+                        "results": sess,
+                    }
+                    break
+        except Exception:
+            pass
     if not results and tpu_alive and _remaining() - 20 >= 120:
         # TPU answered the probe but no variant finished — last-ditch CPU
         degraded = True
@@ -468,6 +508,8 @@ def main() -> None:
         }
         if degraded:
             out["degraded"] = True
+        if tpu_session:
+            out["tpu_session"] = tpu_session
         if notes:
             out["notes"] = "; ".join(notes)
         out["all_variants"] = [
@@ -491,6 +533,8 @@ def main() -> None:
             "tpu_probe": "alive" if tpu_alive else (probe_err or "dead"),
             "notes": "; ".join(notes) or "all variants failed",
         }
+        if tpu_session:
+            out["tpu_session"] = tpu_session
     print(json.dumps(out))
 
 

@@ -189,6 +189,91 @@ def test_done_record_authoritative_over_stdout_marker(bench, monkeypatch, capsys
     assert "degraded" not in out
 
 
+def test_dead_probe_embeds_archived_tpu_session(bench, monkeypatch, tmp_path, capsys):
+    """A dead round-end probe must not erase on-chip results captured in an
+    earlier healthy window: the newest results/perf/bench_results_tpu_*.jsonl
+    is embedded under tpu_session (headline stays CPU + degraded)."""
+    perf = tmp_path / "results" / "perf"
+    perf.mkdir(parents=True)
+    older = _result("pallas:float32:default:64:20", 700.0)
+    newer = _result("xla:float32:default:64:20", 900.0)
+    newer["peak_hbm_gb"] = 1.25
+    (perf / "bench_results_tpu_20260730T000000Z.jsonl").write_text(
+        json.dumps(older) + "\n")
+    (perf / "bench_results_tpu_20260731T000000Z.jsonl").write_text(
+        json.dumps(newer) + "\n" + json.dumps({"phase": "done"}) + "\n"
+        + json.dumps(dict(_result("xla:float32:cpu:6:4", 10.0), device="cpu"))
+        + "\n")
+
+    def fake_child(args, timeout_s):
+        if args[0] == "--probe":
+            return None, "timeout after 120s"
+        for spec in args[1].split(","):
+            _emit(bench, {"phase": "start", "spec": spec})
+            _emit(bench, _result(spec, 200.0))
+        _emit(bench, {"phase": "done"})
+        return {"ok": True, "phase": "done"}, None
+
+    monkeypatch.setattr(bench, "_run_child", fake_child)
+    out = _run_main(bench, capsys)
+    assert out["degraded"] is True
+    sess = out["tpu_session"]
+    assert "20260731" in sess["source"]  # newest file wins
+    assert sess["results"] == [{k: newer[k] for k in (
+        "spec", "backend", "dtype", "device", "step_ms", "peak_hbm_gb",
+        "nodes_per_sec_per_chip", "compile_s") if k in newer}]  # cpu rec dropped
+    assert "NOT measured by this invocation" in sess["note"]
+
+
+def test_empty_newer_archive_falls_back_to_older(bench, monkeypatch, tmp_path, capsys):
+    """A failed recovery attempt archives a JSONL with no usable device
+    record; it must not mask an older healthy window's archive."""
+    perf = tmp_path / "results" / "perf"
+    perf.mkdir(parents=True)
+    healthy = _result("pallas:float32:default:64:20", 700.0)
+    (perf / "bench_results_tpu_20260730T000000Z.jsonl").write_text(
+        json.dumps(healthy) + "\n")
+    (perf / "bench_results_tpu_20260731T000000Z.jsonl").write_text(
+        json.dumps({"phase": "start", "spec": "xla:float32:default:64:20"})
+        + "\n" + json.dumps({"phase": "error", "spec": "x", "error": "died"})
+        + "\n")
+
+    def fake_child(args, timeout_s):
+        if args[0] == "--probe":
+            return None, "timeout after 120s"
+        for spec in args[1].split(","):
+            _emit(bench, {"phase": "start", "spec": spec})
+            _emit(bench, _result(spec, 200.0))
+        _emit(bench, {"phase": "done"})
+        return {"ok": True, "phase": "done"}, None
+
+    monkeypatch.setattr(bench, "_run_child", fake_child)
+    out = _run_main(bench, capsys)
+    assert "20260730" in out["tpu_session"]["source"]
+    assert out["tpu_session"]["results"][0]["nodes_per_sec_per_chip"] == 700.0
+
+
+def test_live_device_result_omits_tpu_session(bench, monkeypatch, tmp_path, capsys):
+    perf = tmp_path / "results" / "perf"
+    perf.mkdir(parents=True)
+    (perf / "bench_results_tpu_20260731T000000Z.jsonl").write_text(
+        json.dumps(_result("pallas:float32:default:64:20", 700.0)) + "\n")
+
+    def fake_child(args, timeout_s):
+        if args[0] == "--probe":
+            return {"ok": True, "platform": "tpu", "n_devices": 1}, None
+        for spec in args[1].split(","):
+            _emit(bench, {"phase": "start", "spec": spec})
+            _emit(bench, _result(spec, 500.0))
+        _emit(bench, {"phase": "done"})
+        return {"ok": True, "phase": "done"}, None
+
+    monkeypatch.setattr(bench, "_run_child", fake_child)
+    out = _run_main(bench, capsys)
+    assert "degraded" not in out
+    assert "tpu_session" not in out  # fresh device numbers supersede archives
+
+
 def test_vs_baseline_ratio(bench, monkeypatch, tmp_path, capsys):
     with open(tmp_path / "baseline_torch.json", "w") as f:
         json.dump({"ast_nodes_per_sec_per_chip": 100.0, "device": "cpu"}, f)

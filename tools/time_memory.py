@@ -79,6 +79,10 @@ def main() -> None:
     ap.add_argument("--noise_mode", default="",
                     help="override noise_mode (counter routes pallas to the "
                          "flash kernel; shared to the fused kernel)")
+    ap.add_argument("--floor", default="",
+                    help="sbm_floor override ('0.0' lifts the reference's "
+                         "0.01 Bernoulli clamp so the flash kernel's "
+                         "data-dependent tile skip can fire)")
     args = ap.parse_args()
     if args.platform:
         # jax is already imported at module top, so only the config update
@@ -101,6 +105,8 @@ def main() -> None:
         overrides["remat"] = args.remat == "1"
     if args.noise_mode:
         overrides["noise_mode"] = args.noise_mode
+    if args.floor:
+        overrides["sbm_floor"] = float(args.floor)
     cfg = get_config(args.config, **overrides)
     src_v, tgt_v, trip_v = 10_000, 20_000, 1246
     batches = [
@@ -155,6 +161,7 @@ def main() -> None:
         "compute_dtype": cfg.compute_dtype,
         "max_src_len": cfg.max_src_len,
         "noise_mode": cfg.noise_mode,
+        "sbm_floor": cfg.sbm_floor,
         "remat": cfg.remat,
         "batch": cfg.batch_size,
         "device": str(jax.devices()[0]),
