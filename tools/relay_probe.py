@@ -1,0 +1,53 @@
+"""Claim-free TPU-relay liveness probe.
+
+The axon chip grant is claim-based and fragile: a full ``jax.devices()``
+probe claims the chip, and claim churn against a wedged or busy relay is
+exactly what poisons it (results/perf/tpu_session_r3.md). But the relay
+itself (/root/.relay.py, driver infrastructure) is a plain TCP fan-in on
+localhost ports — a bare ``connect()`` is answered (with a 0-byte open
+marker pumped to the far side) or refused instantly, holds no chip claim,
+and cannot wedge anything.
+
+Protocol observed 2026-07-31: relay listens on 127.0.0.1:{8082,8083,...};
+when its stdio far end (the driver tunnel) is gone the process dies and
+connects are refused. TCP-accept therefore means "relay process up", which
+is necessary-but-not-sufficient for a usable chip — callers that get
+``alive`` may follow up with one real ``bench.py --probe`` (which performs
+an actual backend init) before spending a claim on measurement work.
+
+Exit codes: 0 = a relay port accepted, 3 = all refused/timed out.
+
+    python tools/relay_probe.py [--quiet]
+"""
+
+from __future__ import annotations
+
+import json
+import socket
+import sys
+
+# first ports of each triple in /root/.relay.py's PORTS list; one accept
+# anywhere is enough
+PORTS = (8082, 8083, 8087, 8092, 8102, 8112)
+
+
+def relay_alive(timeout_s: float = 2.0) -> int | None:
+    """Return the first accepting relay port, or None."""
+    for port in PORTS:
+        try:
+            with socket.create_connection(("127.0.0.1", port), timeout=timeout_s):
+                return port
+        except OSError:
+            continue
+    return None
+
+
+def main() -> None:
+    port = relay_alive()
+    if "--quiet" not in sys.argv:
+        print(json.dumps({"relay_alive": port is not None, "port": port}))
+    sys.exit(0 if port is not None else 3)
+
+
+if __name__ == "__main__":
+    main()

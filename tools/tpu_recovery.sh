@@ -1,9 +1,18 @@
 #!/bin/bash
-# TPU-recovery measurement sequence (run the moment `bench.py --probe`
-# answers — the first healthy window may be the only one; see
-# results/perf/tpu_session_r3.md for the claim rules this encodes).
+# TPU-recovery measurement sequence (run the moment the relay answers —
+# the first healthy window may be the only one; see
+# results/perf/tpu_session_r3.md and _r4.md for the claim rules this
+# encodes).
 #
-# One chip claim per child, clean exits, warm .jax_cache between stages.
+# Claim rules: one chip claim per child, clean exits, warm .jax_cache
+# between stages. `timeout`'s SIGTERM cannot stop a child stuck inside a
+# native compile RPC (observed r4: the handler never runs while the main
+# thread polls the relay socket), so after every stage we check the relay
+# at TCP level (tools/relay_probe.py — claim-free) and BAIL Out if it is
+# gone instead of cascading more claimants into a dead tunnel. A stuck
+# child is SIGKILLed only when the relay is already dead (nothing left to
+# wedge); while the relay lives we always wait.
+#
 # Usage:  bash tools/tpu_recovery.sh [results_dir]
 set -u
 cd "$(dirname "$0")/.."
@@ -12,6 +21,49 @@ mkdir -p "$OUT"
 STAMP=$(date -u +%Y%m%dT%H%M%SZ)
 LOG="$OUT/tpu_recovery_$STAMP.log"
 say() { echo "[$(date -u +%T)] $*" | tee -a "$LOG"; }
+
+relay_up() { python tools/relay_probe.py --quiet; }
+
+# Run "$@" under a hard cap (first arg = seconds). If the cap fires and the
+# child survives SIGTERM (native-stuck), SIGKILL it IFF the relay is dead.
+# Diagnostics go straight to $LOG (never stdout: several callers redirect
+# run_capped's stdout into JSONL results files).
+diag() { echo "[$(date -u +%T)] $*" >> "$LOG"; }
+
+run_capped() {
+  local cap=$1; shift
+  "$@" &
+  local pid=$!
+  local t=0
+  local termed=0
+  while kill -0 "$pid" 2>/dev/null; do
+    sleep 15; t=$((t + 15))
+    if [ "$t" -ge "$cap" ]; then
+      if relay_up; then
+        # over budget but the tunnel lives: request a clean exit ONCE (the
+        # child's SIGTERM handler emits evidence + releases its claim when
+        # it next reaches Python; a second TERM mid-handler would abort
+        # that cleanup) and KEEP WAITING — SIGKILLing a live claimant is
+        # the documented wedge mechanism, and while it holds the claim no
+        # later stage could run anyway.
+        if [ "$termed" -eq 0 ]; then
+          kill -TERM "$pid" 2>/dev/null
+          termed=1
+          diag "  over cap at ${t}s — sent SIGTERM once, waiting (relay up)"
+        elif [ $((t % 300)) -lt 15 ]; then
+          diag "  still waiting on pid $pid (${t}s, relay up)"
+        fi
+      else
+        # tunnel gone: the compile can never return and there is no live
+        # relay state left to wedge — reap the zombie claimant
+        diag "  relay dead at ${t}s — SIGKILL pid $pid"
+        kill -9 "$pid" 2>/dev/null
+      fi
+    fi
+  done
+  wait "$pid"
+  return $?
+}
 
 say "probe"
 timeout 150 python bench.py --probe >> "$LOG" 2>&1 || { say "probe dead rc=$?"; exit 1; }
@@ -22,25 +74,31 @@ timeout 150 python bench.py --probe >> "$LOG" 2>&1 || { say "probe dead rc=$?"; 
 for SPEC in pallas:float32:default:64:20 xla:float32:default:64:20 \
             xla:bfloat16:default:64:20 pallas:bfloat16:default:64:20; do
   say "serve $SPEC"
-  timeout 1100 python bench.py --serve "$SPEC" 900 >> "$LOG" 2>&1
+  run_capped 1500 python bench.py --serve "$SPEC" 1350 >> "$LOG" 2>&1
   say "serve $SPEC rc=$? (results in .bench_results.jsonl)"
-  timeout 150 python bench.py --probe >> "$LOG" 2>&1 || { say "relay died after $SPEC"; break; }
+  relay_up || { say "relay died after $SPEC — stopping claim attempts"; break; }
 done
 cp -f .bench_results.jsonl "$OUT/bench_results_tpu_$STAMP.jsonl" 2>/dev/null
 
+relay_up || exit 2
+
 # 2. time/memory matrix on-chip (real peak HBM per N/remat/kernel combo)
 say "memory matrix (tpu)"
-timeout 5400 python tools/memory_matrix.py --device tpu \
+run_capped 5400 python tools/memory_matrix.py --device tpu \
   --out "$OUT/memory_matrix_tpu_$STAMP.jsonl" >> "$LOG" 2>&1
 say "memory matrix rc=$?"
+relay_up || exit 2
 
-# 3. pallas-vs-xla step time at the sparsity floors (the block-skip bet)
-for ARGS in "--backend pallas --noise_mode counter" \
+# 3. pallas-vs-xla step time, incl. the block-sparsity floor sweep
+#    (VERDICT r3 #2: does the data-dependent tile skip pay on the MXU?)
+for ARGS in "--backend pallas --noise_mode counter --floor 0.01" \
+            "--backend pallas --noise_mode counter --floor 0.0" \
             "--backend xla --noise_mode counter"; do
-  for FLOOR_CFG in "" "--max_src_len 512"; do
-    say "time_memory $ARGS $FLOOR_CFG"
-    timeout 1500 python tools/time_memory.py --config python $ARGS $FLOOR_CFG \
-      --batch 64 --reps 5 --steps 4 >> "$LOG" 2>&1
+  for LEN in "" "--max_src_len 512"; do
+    say "time_memory $ARGS $LEN"
+    run_capped 1500 python tools/time_memory.py --config python $ARGS $LEN \
+      --batch 64 --reps 5 --steps 4 >> "$OUT/time_memory_tpu_$STAMP.jsonl" 2>>"$LOG"
+    relay_up || { say "relay died in time_memory sweep"; exit 2; }
   done
 done
 
