@@ -2,15 +2,17 @@
 # TPU-relay watch loop: claim-free TCP tick every ~2 min; only when the
 # relay process is up does it spend one real backend-init probe
 # (bench.py --probe, self-limiting) to confirm the chip answers. Appends
-# one line per tick to the log; exits the moment a full probe succeeds so
-# an orchestrator (or the operator) can launch tools/tpu_recovery.sh into
-# the fresh window.
+# one line per tick to the log. The moment a full probe succeeds it
+# LAUNCHES tools/tpu_recovery.sh itself (windows have lasted minutes —
+# waiting for an operator forfeits them) and exits.
 #
-# Usage: bash tools/probe_loop.sh [logfile] [interval_s]
+# Usage: bash tools/probe_loop.sh [logfile] [interval_s] [--no-launch]
 set -u
 cd "$(dirname "$0")/.."
 LOG=${1:-results/perf/probe_r4.log}
 INTERVAL=${2:-120}
+LAUNCH=1
+[ "${3:-}" = "--no-launch" ] && LAUNCH=0
 
 while true; do
   TS=$(date -u +%Y-%m-%dT%H:%M:%SZ)
@@ -22,6 +24,11 @@ while true; do
     rm -f "$PROBE_OUT"
     if [ "$RC" -eq 0 ]; then
       echo "$TS ALIVE" >> "$LOG"
+      if [ "$LAUNCH" -eq 1 ]; then
+        echo "$TS launching tpu_recovery.sh" >> "$LOG"
+        bash tools/tpu_recovery.sh results/perf >> "$LOG" 2>&1
+        echo "$(date -u +%Y-%m-%dT%H:%M:%SZ) tpu_recovery.sh rc=$?" >> "$LOG"
+      fi
       exit 0
     fi
   else

@@ -71,7 +71,10 @@ timeout 150 python bench.py --probe >> "$LOG" 2>&1 || { say "probe dead rc=$?"; 
 # 1. bench variants, proven-first, ONE serve child per variant so an
 #    overrun never takes later variants down with it (soft budget 900 s,
 #    first compiles can exceed 600 s through the remote compiler)
-for SPEC in pallas:float32:default:64:20 xla:float32:default:64:20 \
+# xla:f32 first: it is the fastest compile (r3 CPU: 36 s vs pallas' larger
+# Mosaic pipeline) and windows have closed within minutes — the ordering
+# maximizes the chance that a short window still lands ONE device number.
+for SPEC in xla:float32:default:64:20 pallas:float32:default:64:20 \
             xla:bfloat16:default:64:20 pallas:bfloat16:default:64:20; do
   say "serve $SPEC"
   run_capped 1500 python bench.py --serve "$SPEC" 1350 >> "$LOG" 2>&1
