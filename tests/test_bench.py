@@ -106,8 +106,8 @@ def test_killed_serve_retries_untried_first(bench, monkeypatch, capsys):
             _emit(bench, _result(specs[0], 100.0))
             _emit(bench, {"phase": "start", "spec": specs[1]})
             return None, "timeout after 555s"
-        # retry round: killed spec must be queued last
-        assert specs[-1].startswith("xla:float32"), specs
+        # retry round: the killed spec (2nd = pallas:f32) must be queued last
+        assert specs[-1].startswith("pallas:float32"), specs
         for spec in specs:
             _emit(bench, {"phase": "start", "spec": spec})
             _emit(bench, _result(spec, 300.0))
