@@ -104,6 +104,13 @@ class Config:
     #               (the Bernoulli stream must be computable per-block from
     #               global indices). No-op outside a seq>1 mesh.
     seq_impl: str = "allgather"
+    # GPipe pipeline parallelism over a `pipe` mesh axis
+    # (csat_tpu/parallel/pipeline.py): >1 splits the SBM block stack into
+    # that many stages (sbm_layers must divide evenly; clusters must be
+    # uniform so stage params stack). 0/1 = off. Microbatches default to
+    # the stage count (0 = auto); the local batch must divide evenly.
+    pipeline_stages: int = 0
+    pipeline_microbatches: int = 0
     param_dtype: str = "float32"
     compute_dtype: str = "float32"  # "bfloat16" for MXU-friendly training
     mesh_shape: Tuple[Tuple[str, int], ...] = (("data", 1), ("model", 1))
@@ -175,6 +182,32 @@ class Config:
                 )
         assert self.sbm_enc_dim % self.num_heads == 0
         assert len(self.clusters) == self.sbm_layers
+        if self.pipeline_stages > 1:
+            if self.sbm_layers % self.pipeline_stages:
+                raise ValueError(
+                    f"pipeline_stages={self.pipeline_stages} must divide "
+                    f"sbm_layers={self.sbm_layers}"
+                )
+            if not self.full_att and len(set(self.clusters)) != 1:
+                raise ValueError(
+                    "pipeline execution stacks stage params — clusters must "
+                    f"be uniform, got {self.clusters}"
+                )
+            for name, size in self.mesh_shape:
+                if name in ("model", "seq") and size != 1:
+                    raise ValueError(
+                        "pipeline_stages>1 composes with the 'data' mesh "
+                        "axis only (v1): inside the pipeline shard_map the "
+                        f"'{name}' collectives would need manual "
+                        "re-derivation"
+                    )
+            if dict(self.mesh_shape).get("pipe") != self.pipeline_stages:
+                raise ValueError(
+                    f"pipeline_stages={self.pipeline_stages} needs a "
+                    f"('pipe', {self.pipeline_stages}) axis in mesh_shape "
+                    f"(got {self.mesh_shape}) — without it the wavefront "
+                    "silently never activates"
+                )
         if self.use_pegen == "sequential":
             assert self.pe_dim == 0, "sequential PE uses pe_dim=0 (config/python_seq.py)"
         else:

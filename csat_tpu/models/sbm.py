@@ -304,21 +304,92 @@ class SBMEncoder(nn.Module):
         x = constrain(x, "data", "seq", None)
         sparsities: List[jnp.ndarray] = []
         graphs, attns = [], []
-        # remat: recompute block activations in backward instead of storing
-        # them (jax.checkpoint) — the long-AST memory lever (SURVEY §7.1)
-        block_cls = (
-            nn.remat(SBMBlock, static_argnums=(3, 4)) if cfg.remat else SBMBlock
+        # GPipe pipeline parallelism over a `pipe` mesh axis: the homogeneous
+        # block stack runs as a shard_map wavefront (parallel/pipeline.py).
+        # Init/aux/probe paths and meshes without a pipe axis take the
+        # sequential loop below — same params either way.
+        from csat_tpu.parallel.pipeline import (
+            gpipe_blocks,
+            pipeline_ready,
+            stack_layer_params,
         )
-        for i in range(cfg.sbm_layers):
-            x, sparsity, graph, attn = block_cls(cfg, i, self.dtype, name=f"transformer_{i}")(
-                x, key_pad, deterministic, collect_aux
+
+        use_pipe = (
+            cfg.pipeline_stages > 1
+            and not collect_aux
+            and not self.is_initializing()
+            and pipeline_ready(cfg.pipeline_stages)
+        )
+        if use_pipe:
+            x, pipe_sparsity = self._pipelined_blocks(
+                x, key_pad, deterministic, gpipe_blocks, stack_layer_params
             )
-            x = constrain(x, "data", "seq", None)
-            sparsities.append(sparsity)
-            if collect_aux:
-                graphs.append(graph)
-                attns.append(attn)
+            sparsities = (
+                [None] * cfg.sbm_layers if cfg.full_att else list(pipe_sparsity)
+            )
+        else:
+            # remat: recompute block activations in backward instead of
+            # storing them (jax.checkpoint) — the long-AST memory lever
+            # (SURVEY §7.1)
+            block_cls = (
+                nn.remat(SBMBlock, static_argnums=(3, 4)) if cfg.remat else SBMBlock
+            )
+            for i in range(cfg.sbm_layers):
+                x, sparsity, graph, attn = block_cls(cfg, i, self.dtype, name=f"transformer_{i}")(
+                    x, key_pad, deterministic, collect_aux
+                )
+                x = constrain(x, "data", "seq", None)
+                sparsities.append(sparsity)
+                if collect_aux:
+                    graphs.append(graph)
+                    attns.append(attn)
         x = nn.LayerNorm(epsilon=LN_EPS, dtype=self.dtype)(x)
         x = x * (1.0 - key_pad.astype(x.dtype))[:, :, None]  # zero pads post-norm (quirk §8.11)
         x = dense(cfg.hidden_size, self.dtype, name="out")(x)
         return x, sparsities, graphs, attns, pe
+
+    def _pipelined_blocks(
+        self, x, key_pad, deterministic, gpipe_blocks, stack_layer_params
+    ):
+        """Run the block stack as a GPipe wavefront (parallel/pipeline.py).
+
+        Stacks the per-layer ``transformer_{i}`` param subtrees created at
+        init (the flagship tree is unchanged — checkpoints stay
+        interchangeable with sequential execution) and hands each (layer,
+        microbatch) pair its own fold-in RNG key.
+        """
+        cfg = self.cfg
+        layer_params = [
+            self.get_variable("params", f"transformer_{i}")
+            for i in range(cfg.sbm_layers)
+        ]
+        stacked = stack_layer_params(layer_params)
+        n_micro = cfg.pipeline_microbatches or cfg.pipeline_stages
+        sample_keys = jax.random.split(
+            self.make_rng("sample"), (cfg.sbm_layers, n_micro)
+        )
+        use_dropout = not deterministic
+        dropout_keys = (
+            jax.random.split(self.make_rng("dropout"), (cfg.sbm_layers, n_micro))
+            if use_dropout
+            else None
+        )
+        block = SBMBlock(cfg, 0, self.dtype)
+
+        def block_apply(p, xm, padm, sk, dk):
+            rngs = {"sample": sk}
+            if dk is not None:
+                rngs["dropout"] = dk
+            y, sp, _, _ = block.apply(
+                {"params": p}, xm, padm, deterministic, False, rngs=rngs
+            )
+            if sp is None:  # full_att blocks report no sparsity
+                sp = jnp.zeros((cfg.num_heads,), jnp.float32)
+            return y, sp
+
+        if cfg.remat:
+            block_apply = jax.checkpoint(block_apply)
+        return gpipe_blocks(
+            block_apply, stacked, x, key_pad, sample_keys, dropout_keys,
+            n_micro, cfg.pipeline_stages,
+        )

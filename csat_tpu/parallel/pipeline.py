@@ -1,0 +1,166 @@
+"""GPipe-style pipeline parallelism for the SBM encoder stack.
+
+The reference has **no** pipeline parallelism (SURVEY §2.3: its only
+strategy is single-node DDP, ``/root/reference/script/train.py:331``); this
+module is a TPU-native extension in the same spirit as the repo's tensor /
+sequence parallelism: the encoder's homogeneous ``transformer_i`` blocks
+become pipeline *stages* laid out over a ``pipe`` mesh axis, and
+microbatches stream through them in the classic GPipe wavefront —
+implemented the XLA way with ``jax.shard_map`` + ``lax.ppermute`` over ICI
+and a ``lax.scan`` over wavefront ticks (no Python-level device control).
+
+Design choices:
+
+* **Execution strategy, not a different model.** The flagship param tree
+  keeps its per-layer ``transformer_{i}`` subtrees; at apply time the
+  encoder stacks them (``stack_layer_params``) and hands the wavefront a
+  ``(L, ...)``-leading pytree that ``shard_map`` splits over ``pipe``
+  (``L/P`` consecutive layers per stage). Checkpoints are interchangeable
+  between pipelined and sequential execution.
+* **Wavefront**: with ``P`` stages and ``M`` microbatches, tick ``t`` has
+  stage ``r`` processing microbatch ``t - r`` (valid for
+  ``r ≤ t < r + M``); activations hop ``r → r+1`` via ``ppermute`` after
+  every tick; ``T = M + P - 1`` ticks total. Out-of-range ticks compute on
+  clamped garbage whose outputs are never read (and therefore contribute
+  zero cotangent) — the standard static-shape XLA formulation of the
+  pipeline bubble.
+* **Sampling/dropout RNG**: each (layer, microbatch) pair gets its own
+  fold-in key, precomputed as a ``(L, M)`` key array sharded over ``pipe``
+  — every stage can regenerate its draws without cross-stage RNG state.
+* **Sparsity** (the SBM regularizer): per-(layer, micro) head sparsities
+  are averaged over microbatches (algebraically equal to the full-batch
+  value), ``pmean``-ed over ``data`` and ``all_gather``-ed over ``pipe``.
+* **Composition**: ``data`` (DP) composes freely — the batch stays sharded
+  over ``data``, the wavefront runs per data-shard. ``model``/``seq`` do
+  NOT compose with the pipeline in v1 (inside ``shard_map`` their
+  collectives would need manual re-derivation); ``Config.validate``
+  rejects those meshes.
+* **Residency**: v1 distributes *compute* (each stage's matmuls run on its
+  own device concurrently); stored params remain replicated across
+  ``pipe`` (the stacked operand is resharded by the partitioner on entry).
+  At this model's size (~32 M params) residency is not the constraint;
+  a stacked-storage layout with a ``P('pipe', ...)`` placement rule is the
+  natural extension if it becomes one.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+__all__ = ["gpipe_blocks", "pipeline_ready", "stack_layer_params"]
+
+
+def pipeline_ready(n_stages: int) -> bool:
+    """True when the ambient mesh carries a ``pipe`` axis of exactly
+    ``n_stages`` devices (set via ``jax.sharding.set_mesh``)."""
+    mesh = jax.sharding.get_abstract_mesh()
+    if mesh is None or "pipe" not in mesh.axis_names:
+        return False
+    return int(mesh.shape["pipe"]) == n_stages
+
+
+def stack_layer_params(layer_params: Sequence[Any]) -> Any:
+    """Stack per-layer param subtrees into one pytree with leading axis L."""
+    return jax.tree.map(lambda *xs: jnp.stack(xs), *layer_params)
+
+
+def _dyn(x: jnp.ndarray, i: jnp.ndarray) -> jnp.ndarray:
+    return jax.lax.dynamic_index_in_dim(x, i, axis=0, keepdims=False)
+
+
+def gpipe_blocks(
+    block_apply: Callable[..., Tuple[jnp.ndarray, jnp.ndarray]],
+    stacked_params: Any,
+    x: jnp.ndarray,  # (B, N, D) — batch sharded over `data`
+    key_pad: jnp.ndarray,  # (B, N)
+    sample_keys: jnp.ndarray,  # (L, M) PRNG keys
+    dropout_keys: Optional[jnp.ndarray],  # (L, M) keys or None
+    n_micro: int,
+    n_stages: int,
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Run the stacked encoder blocks as a GPipe wavefront.
+
+    ``block_apply(params_one_layer, x_mb, pad_mb, sample_key, dropout_key)``
+    must return ``(x_mb, sparsity_per_head)``. Returns ``(x_out, sparsity)``
+    with ``x_out`` sharded like ``x`` and ``sparsity`` of shape ``(L, H)``
+    replicated.
+    """
+    mesh = jax.sharding.get_abstract_mesh()
+    assert mesh is not None and "pipe" in mesh.axis_names, (
+        "gpipe_blocks needs an ambient mesh with a 'pipe' axis "
+        "(jax.sharding.set_mesh)"
+    )
+    has_data = "data" in mesh.axis_names
+    d = "data" if has_data else None
+    has_dropout = dropout_keys is not None
+    if not has_dropout:  # placeholder so the pytree shape is static
+        dropout_keys = sample_keys
+
+    def per_device(params_loc, x_loc, pad_loc, skeys_loc, dkeys_loc):
+        r = jax.lax.axis_index("pipe")
+        layers_loc = jax.tree.leaves(params_loc)[0].shape[0]  # = L / P
+        b_loc = x_loc.shape[0]
+        assert b_loc % n_micro == 0, (
+            f"local batch {b_loc} not divisible by {n_micro} microbatches"
+        )
+        mb = b_loc // n_micro
+        x_all = x_loc.reshape(n_micro, mb, *x_loc.shape[1:])
+        pads = pad_loc.reshape(n_micro, mb, *pad_loc.shape[1:])
+        ticks = n_micro + n_stages - 1
+
+        def tick(buf, t):
+            mid = jnp.clip(t - r, 0, n_micro - 1)  # microbatch at this stage
+            x_in = jnp.where(
+                r == 0, _dyn(x_all, jnp.clip(t, 0, n_micro - 1)), buf
+            )
+            pad_mb = _dyn(pads, mid)
+            y = x_in
+            sps = []
+            for j in range(layers_loc):
+                p_j = jax.tree.map(lambda a: a[j], params_loc)
+                dk = _dyn(dkeys_loc[j], mid) if has_dropout else None
+                y, sp = block_apply(p_j, y, pad_mb, _dyn(skeys_loc[j], mid), dk)
+                sps.append(sp)
+            y_next = jax.lax.ppermute(
+                y, "pipe", [(i, i + 1) for i in range(n_stages - 1)]
+            )
+            return y_next, (y, jnp.stack(sps))
+
+        # the carry must be marked varying over `pipe` up front (the loop
+        # body makes it so via the stage params; scan demands equal types).
+        # pcast is the jax≥0.9 spelling; pvary the deprecated fallback.
+        zeros = jnp.zeros_like(x_all[0])
+        if hasattr(jax.lax, "pcast"):
+            buf0 = jax.lax.pcast(zeros, "pipe", to="varying")
+        else:  # pragma: no cover
+            buf0 = jax.lax.pvary(zeros, "pipe")
+        _, (ys, sps) = jax.lax.scan(tick, buf0, jnp.arange(ticks))
+        # the last stage's outputs at ticks P-1 .. T-1 are microbatches 0..M-1
+        is_last = (r == n_stages - 1).astype(ys.dtype)
+        out = jax.lax.psum(ys * is_last, "pipe")[n_stages - 1:]
+        out = out.reshape(b_loc, *x_loc.shape[1:])
+        # stage r's valid ticks are [r, r+M); microbatch-mean == batch value
+        tt = jnp.arange(ticks)
+        valid = ((tt >= r) & (tt < r + n_micro)).astype(sps.dtype)
+        sp_loc = (sps * valid[:, None, None]).sum(0) / n_micro  # (L/P, H)
+        if has_data:
+            sp_loc = jax.lax.pmean(sp_loc, "data")
+        # assemble the full (L, H) via zero-pad + psum (psum's replication
+        # over `pipe` is statically visible to the VMA checker; all_gather's
+        # is not)
+        full = jnp.zeros((layers_loc * n_stages, sp_loc.shape[1]), sp_loc.dtype)
+        full = jax.lax.dynamic_update_slice(full, sp_loc, (r * layers_loc, 0))
+        sp_all = jax.lax.psum(full, "pipe")  # (L, H)
+        return out, sp_all
+
+    out, sparsity = jax.shard_map(
+        per_device,
+        mesh=mesh,
+        in_specs=(P("pipe"), P(d), P(d), P("pipe"), P("pipe")),
+        out_specs=(P(d), P()),
+    )(stacked_params, x, key_pad, sample_keys, dropout_keys)
+    return out, sparsity

@@ -1,0 +1,237 @@
+"""Pipeline parallelism (GPipe wavefront, csat_tpu/parallel/pipeline.py).
+
+The reference has no pipeline parallelism at all (SURVEY §2.3 — DDP only);
+these tests pin the TPU-native extension: the wavefront must compute
+exactly what a sequential microbatched pass over the same stacked params
+and the same per-(layer, microbatch) RNG keys computes, and the full train
+step must run under a dp×pipe mesh.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from csat_tpu.configs import get_config
+from csat_tpu.models.sbm import SBMBlock
+from csat_tpu.parallel.mesh import build_mesh
+from csat_tpu.parallel.pipeline import gpipe_blocks, pipeline_ready, stack_layer_params
+
+
+def _tiny_cfg(**kw):
+    base = dict(
+        pe_dim=8, pegen_dim=16, sbm_enc_dim=32, hidden_size=32, num_heads=4,
+        num_layers=1, sbm_layers=4, clusters=(3, 3, 3, 3),
+        dim_feed_forward=64, max_src_len=16, max_tgt_len=8, batch_size=8,
+        tree_pos_width=4, tree_pos_height=4, noise_mode="counter",
+    )
+    base.update(kw)
+    if base.get("pipeline_stages", 0) > 1 and "mesh_shape" not in base:
+        base["mesh_shape"] = (("data", 1), ("pipe", base["pipeline_stages"]))
+    return get_config("python", **base)
+
+
+def _init_blocks(cfg, n, x, pad):
+    block = SBMBlock(cfg, 0, jnp.float32)
+    params = [
+        block.init(
+            {"params": jax.random.key(100 + i), "sample": jax.random.key(0)},
+            x[:1], pad[:1], True, False,
+        )["params"]
+        for i in range(n)
+    ]
+    return block, params
+
+
+def _sequential_reference(block, layer_params, x, pad, skeys, dkeys, n_micro,
+                          deterministic, n_data=1):
+    """Loop microbatches through the layers with the same per-(l, m) keys.
+
+    Microbatching happens *per data shard* (matching the pipeline, where
+    each data-parallel group splits its local batch): shard ``s``'s ``m``-th
+    microbatch uses key ``(l, m)`` — the same key across shards, exactly as
+    the replicated-key shard_map does.
+    """
+    b = x.shape[0]
+    mb = b // (n_data * n_micro)
+    xr = np.asarray(x).reshape(n_data, n_micro, mb, *x.shape[1:])
+    pr = np.asarray(pad).reshape(n_data, n_micro, mb, *pad.shape[1:])
+    outs = np.zeros_like(xr)
+    spars = []
+    for s in range(n_data):
+        for m in range(n_micro):
+            y = jnp.asarray(xr[s, m])
+            sps = []
+            for l, p in enumerate(layer_params):
+                rngs = {"sample": skeys[l, m]}
+                if dkeys is not None:
+                    rngs["dropout"] = dkeys[l, m]
+                y, sp, _, _ = block.apply(
+                    {"params": p}, y, jnp.asarray(pr[s, m]), deterministic,
+                    False, rngs=rngs,
+                )
+                sps.append(sp)
+            outs[s, m] = np.asarray(y)
+            spars.append(jnp.stack(sps))  # (L, H)
+    out = jnp.asarray(outs.reshape(b, *x.shape[1:]))
+    sparsity = jnp.mean(jnp.stack(spars), axis=0)  # mean over shards+micros
+    return out, sparsity
+
+
+@pytest.mark.parametrize("pipe,n_micro,data", [(4, 2, 2), (2, 4, 2), (4, 2, 1)])
+def test_wavefront_matches_sequential_microbatched(pipe, n_micro, data):
+    cfg = _tiny_cfg(pipeline_stages=pipe, pipeline_microbatches=n_micro)
+    b, n, dmodel = 8, cfg.max_src_len, cfg.sbm_enc_dim
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.normal(size=(b, n, dmodel)), jnp.float32)
+    pad = jnp.asarray(rng.random((b, n)) < 0.2)
+    block, layer_params = _init_blocks(cfg, cfg.sbm_layers, x, pad)
+    skeys = jax.random.split(jax.random.key(7), (cfg.sbm_layers, n_micro))
+
+    ref_out, ref_sp = _sequential_reference(
+        block, layer_params, x, pad, skeys, None, n_micro, True, n_data=data
+    )
+
+    mesh = build_mesh((("data", data), ("pipe", pipe)))
+
+    def block_apply(p, xm, padm, sk, dk):
+        y, sp, _, _ = block.apply({"params": p}, xm, padm, True, False,
+                                  rngs={"sample": sk})
+        return y, sp
+
+    stacked = stack_layer_params(layer_params)
+    with jax.sharding.set_mesh(mesh):
+        assert pipeline_ready(pipe)
+        out, sp = jax.jit(
+            lambda s, xx, pp: gpipe_blocks(
+                block_apply, s, xx, pp, skeys, None, n_micro, pipe
+            )
+        )(stacked, x, pad)
+
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref_out),
+                               rtol=1e-5, atol=1e-5)
+    np.testing.assert_allclose(np.asarray(sp), np.asarray(ref_sp),
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_wavefront_with_dropout_matches_sequential():
+    """Non-deterministic mode: dropout + sampling keys line up per stage."""
+    cfg = _tiny_cfg(pipeline_stages=2, pipeline_microbatches=2,
+                    dropout=0.3, attention_dropout=0.2)
+    b, n, dmodel = 4, cfg.max_src_len, cfg.sbm_enc_dim
+    rng = np.random.default_rng(1)
+    x = jnp.asarray(rng.normal(size=(b, n, dmodel)), jnp.float32)
+    pad = jnp.asarray(rng.random((b, n)) < 0.2)
+    block, layer_params = _init_blocks(cfg, cfg.sbm_layers, x, pad)
+    skeys = jax.random.split(jax.random.key(3), (cfg.sbm_layers, 2))
+    dkeys = jax.random.split(jax.random.key(4), (cfg.sbm_layers, 2))
+
+    ref_out, _ = _sequential_reference(
+        block, layer_params, x, pad, skeys, dkeys, 2, False, n_data=2
+    )
+
+    def block_apply(p, xm, padm, sk, dk):
+        y, sp, _, _ = block.apply({"params": p}, xm, padm, False, False,
+                                  rngs={"sample": sk, "dropout": dk})
+        return y, sp
+
+    mesh = build_mesh((("data", 2), ("pipe", 2)))
+    with jax.sharding.set_mesh(mesh):
+        out, _ = jax.jit(
+            lambda s, xx, pp: gpipe_blocks(
+                block_apply, s, xx, pp, skeys, dkeys, 2, 2
+            )
+        )(stack_layer_params(layer_params), x, pad)
+
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref_out),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_pipeline_ready_gating():
+    cfg = _tiny_cfg(pipeline_stages=4)
+    assert cfg.pipeline_stages == 4
+    # no ambient mesh → not ready
+    assert not pipeline_ready(4)
+    with jax.sharding.set_mesh(build_mesh((("data", 2), ("pipe", 4)))):
+        assert pipeline_ready(4)
+        assert not pipeline_ready(2)  # wrong stage count
+    with jax.sharding.set_mesh(build_mesh((("data", 8),))):
+        assert not pipeline_ready(4)  # no pipe axis
+
+
+def test_config_validation():
+    with pytest.raises(ValueError, match="divide"):
+        _tiny_cfg(pipeline_stages=3)
+    with pytest.raises(ValueError, match="uniform"):
+        _tiny_cfg(pipeline_stages=2, clusters=(3, 3, 3, 5))
+    with pytest.raises(ValueError, match="data"):
+        _tiny_cfg(pipeline_stages=2,
+                  mesh_shape=(("data", 2), ("model", 2), ("pipe", 2)))
+    with pytest.raises(ValueError, match="pipe"):
+        # mesh without the pipe axis: the wavefront could silently never
+        # activate — validate() must reject instead
+        _tiny_cfg(pipeline_stages=2, mesh_shape=(("data", 8),))
+
+
+@pytest.mark.slow
+def test_full_train_step_under_dp_pipe_mesh():
+    """End-to-end: loss+grads+optimizer under a dp2×pipe4 mesh; the encoder
+    runs the wavefront (params untouched — flagship tree), loss is finite,
+    every stage's params receive gradient, and the step is deterministic."""
+    cfg = _tiny_cfg(
+        pipeline_stages=4, pipeline_microbatches=2, batch_size=8,
+        mesh_shape=(("data", 2), ("pipe", 4)),
+    )
+    # spy: the encoder's use_pipe gate must actually route through the
+    # wavefront (every assertion below would also pass on the sequential
+    # fallback, so a gate regression would otherwise be invisible)
+    import csat_tpu.parallel.pipeline as pipeline_mod
+
+    real_gpipe = pipeline_mod.gpipe_blocks
+    calls = []
+
+    def spy(*a, **kw):
+        calls.append(1)
+        return real_gpipe(*a, **kw)
+
+    pipeline_mod.gpipe_blocks = spy
+    try:
+        _run_train_step_body(cfg)
+    finally:
+        pipeline_mod.gpipe_blocks = real_gpipe
+    assert calls, "encoder never engaged the pipeline wavefront"
+
+
+def _run_train_step_body(cfg):
+    from csat_tpu.data.toy import random_batch
+    from csat_tpu.parallel.mesh import replicated, shard_batch
+    from csat_tpu.train.loop import make_train_step
+    from csat_tpu.train.state import create_train_state, default_optimizer, make_model
+
+    src_v, tgt_v, trip_v = 97, 83, 31
+    batch = random_batch(cfg, cfg.batch_size, src_v, tgt_v, trip_v, seed=0)
+    model = make_model(cfg, src_v, tgt_v, trip_v)
+    tx = default_optimizer(cfg)
+    state = create_train_state(model, tx, batch, seed=0)
+    step = make_train_step(model, tx, cfg)
+
+    mesh = build_mesh(cfg.mesh_shape)
+    host_state = jax.tree.map(jnp.copy, state)  # snapshot: step donates
+    state = jax.device_put(state, replicated(mesh))
+    batch = shard_batch(batch, mesh)
+    with jax.sharding.set_mesh(mesh):
+        new_state, metrics = step(state, batch)
+        loss = float(metrics["loss"])
+        assert np.isfinite(loss)
+        # every stage's block params moved (gradient reached every stage
+        # through the ppermute chain)
+        for i in range(cfg.sbm_layers):
+            old = host_state.params["encoder"][f"transformer_{i}"]["wq"]["kernel"]
+            new = new_state.params["encoder"][f"transformer_{i}"]["wq"]["kernel"]
+            assert not np.allclose(np.asarray(old), np.asarray(new)), i
+
+        # determinism: replaying the step from the same state lands on the
+        # same loss (fold-in keys, no host randomness)
+        state2 = jax.device_put(host_state, replicated(mesh))
+        _, metrics2 = step(state2, batch)
+        assert float(metrics2["loss"]) == loss
