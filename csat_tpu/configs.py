@@ -273,6 +273,14 @@ _reg(_PY.replace(name="python_long", task_name="long_ast_512", max_src_len=512,
                  mesh_shape=(("data", -1),), noise_mode="counter", remat=True,
                  seq_impl="ring"))
 
+# Pipeline-parallel variant (csat_tpu/parallel/pipeline.py): the 4 SBM
+# blocks as 2 GPipe stages over a `pipe` mesh axis, composed with DP —
+# a parallel dimension the reference does not have (SURVEY §2.3: DDP only).
+_reg(_PY.replace(name="python_pp", task_name="pp2_gpipe",
+                 mesh_shape=(("data", -1), ("pipe", 2)),
+                 pipeline_stages=2, pipeline_microbatches=4,
+                 noise_mode="counter"))
+
 
 def get_config(name: str, **overrides) -> Config:
     """Look up a named variant; keyword overrides are applied on top."""

@@ -174,6 +174,47 @@ def test_config_validation():
 
 
 @pytest.mark.slow
+def test_trainer_cli_path_with_pipe_mesh(synthetic_corpus, tiny_config):
+    """Product path: the Trainer builds its mesh from cfg.mesh_shape, so a
+    `pipe` config pipelines through the normal fit/eval flow (the same
+    route `python -m csat_tpu.cli --config python_pp` takes)."""
+    from csat_tpu.data.dataset import ASTDataset
+    from csat_tpu.train.loop import Trainer
+
+    cfg = tiny_config.replace(
+        data_dir=synthetic_corpus, num_epochs=1, val_interval=1,
+        noise_mode="counter", pipeline_stages=2, pipeline_microbatches=2,
+        mesh_shape=(("data", 2), ("pipe", 2)), prefetch=0,
+    )
+    import csat_tpu.parallel.pipeline as pipeline_mod
+
+    real_gpipe = pipeline_mod.gpipe_blocks
+    calls = []
+
+    def spy(*a, **kw):
+        calls.append(1)
+        return real_gpipe(*a, **kw)
+
+    pipeline_mod.gpipe_blocks = spy
+    try:
+        trainer = Trainer(cfg, log=lambda s: None)
+        train_ds = ASTDataset(cfg, "train", trainer.src_vocab, trainer.tgt_vocab)
+        val_ds = ASTDataset(cfg, "dev", trainer.src_vocab, trainer.tgt_vocab)
+        state, history = trainer.fit(train_ds, val_ds)
+    finally:
+        pipeline_mod.gpipe_blocks = real_gpipe
+    assert np.isfinite(history["loss"][-1])
+    assert calls, "Trainer never engaged the pipeline wavefront"
+
+
+def test_python_pp_config_registered():
+    cfg = get_config("python_pp")
+    assert cfg.pipeline_stages == 2
+    assert dict(cfg.mesh_shape)["pipe"] == 2
+    cfg.validate()
+
+
+@pytest.mark.slow
 def test_full_train_step_under_dp_pipe_mesh():
     """End-to-end: loss+grads+optimizer under a dp2×pipe4 mesh; the encoder
     runs the wavefront (params untouched — flagship tree), loss is finite,
