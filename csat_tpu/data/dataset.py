@@ -42,6 +42,7 @@ __all__ = [
     "Batch",
     "ASTDataset",
     "collate",
+    "collate_indexed",
     "load_matrices",
     "save_matrices",
     "node_triplets",
@@ -251,9 +252,6 @@ class ASTDataset:
     def __len__(self) -> int:
         return self.size
 
-    def sample_arrays(self, idx: np.ndarray) -> Dict[str, np.ndarray]:
-        return {k: v[idx] for k, v in self.arrays.items()}
-
 
 def collate(arrs: Dict[str, np.ndarray], max_src_len: int) -> Batch:
     """Raw per-sample arrays → :class:`Batch`, applying the mask-before-offset
@@ -275,6 +273,63 @@ def collate(arrs: Dict[str, np.ndarray], max_src_len: int) -> Batch:
         adj=adj,
         tree_pos=arrs["tree_pos"].astype(np.float32),
         triplet=arrs["triplet"].astype(np.int32),
+    )
+
+
+def collate_indexed(
+    arrays: Dict[str, np.ndarray], idx: np.ndarray, max_src_len: int
+) -> Batch:
+    """Fused gather + collate straight off the dataset-resident arrays.
+
+    The (B, N, N) relation matrices — the input pipeline's byte budget —
+    go through the native single-pass kernel
+    (``csat_tpu/native/collate.cpp``: gather, mask, adjacency,
+    offset+clamp, one read per element) when the toolchain is available;
+    otherwise this degrades to NumPy fancy-index + :func:`collate`.
+    Bit-identical either way (differential-tested)."""
+    from csat_tpu.native import load_collate
+
+    lib = load_collate()
+    L_all, T_all = arrays["L_raw"], arrays["T_raw"]
+    idx64 = np.ascontiguousarray(idx, dtype=np.int64)
+    if (
+        lib is None
+        or L_all.dtype != np.int16
+        or T_all.dtype != np.int16
+        or not L_all.flags["C_CONTIGUOUS"]
+        or not T_all.flags["C_CONTIGUOUS"]
+        # negative (NumPy-wraparound) or out-of-range indices would be
+        # silent out-of-bounds reads in C — NumPy's semantics apply instead
+        or len(idx64) == 0
+        or idx64.min() < 0
+        or idx64.max() >= L_all.shape[0]
+    ):
+        return collate({k: v[idx] for k, v in arrays.items()}, max_src_len)
+
+    b, n = len(idx64), L_all.shape[1]
+    L = np.empty((b, n, n), np.int32)
+    T = np.empty((b, n, n), np.int32)
+    L_mask = np.empty((b, n, n), np.bool_)
+    T_mask = np.empty((b, n, n), np.bool_)
+    adj = np.empty((b, n, n), np.float32)
+    lib.collate_rel_c(
+        L_all.ctypes.data, T_all.ctypes.data, idx64.ctypes.data,
+        b, n, max_src_len // 2, max_src_len - 1,
+        L.ctypes.data, T.ctypes.data,
+        L_mask.ctypes.data, T_mask.ctypes.data, adj.ctypes.data,
+    )
+    return Batch(
+        src_seq=arrays["src_seq"][idx64].astype(np.int32),
+        tgt_seq=arrays["tgt_seq"][idx64].astype(np.int32),
+        target=arrays["target"][idx64].astype(np.int32),
+        L=L,
+        T=T,
+        L_mask=L_mask,
+        T_mask=T_mask,
+        num_node=arrays["num_node"][idx64].astype(np.int32),
+        adj=adj,
+        tree_pos=arrays["tree_pos"][idx64].astype(np.float32),
+        triplet=arrays["triplet"][idx64].astype(np.int32),
     )
 
 
@@ -308,4 +363,4 @@ def iterate_batches(
         chunk = idx[s : s + batch_size]
         if drop_last and len(chunk) < batch_size:
             break
-        yield collate(dataset.sample_arrays(chunk), dataset.config.max_src_len)
+        yield collate_indexed(dataset.arrays, chunk, dataset.config.max_src_len)

@@ -22,11 +22,11 @@ _LIB: Optional[ctypes.CDLL] = None
 _TRIED = False
 
 
-def _build(lib_path: str) -> bool:
-    src = os.path.join(_HERE, "meteor.cpp")
+def _build(lib_path: str, src_name: str = "meteor.cpp", opt: str = "-O2") -> bool:
+    src = os.path.join(_HERE, src_name)
     try:
         subprocess.run(
-            ["g++", "-O2", "-shared", "-fPIC", "-o", lib_path, src],
+            ["g++", opt, "-shared", "-fPIC", "-o", lib_path, src],
             check=True,
             capture_output=True,
             timeout=120,
@@ -36,6 +36,27 @@ def _build(lib_path: str) -> bool:
         return False
 
 
+def _load_lib(src_name: str, lib_name: str, opt: str = "-O2") -> Optional[ctypes.CDLL]:
+    """Compile (once, staleness-checked) and dlopen a native source file."""
+    try:
+        lib_path = os.path.join(_HERE, lib_name)
+        if not os.path.exists(lib_path) or os.path.getmtime(lib_path) < os.path.getmtime(
+            os.path.join(_HERE, src_name)
+        ):
+            # build into a temp file first so concurrent workers never load
+            # a half-written library
+            fd, tmp = tempfile.mkstemp(suffix=".so", dir=_HERE)
+            os.close(fd)
+            if _build(tmp, src_name, opt):
+                os.replace(tmp, lib_path)
+            else:
+                os.unlink(tmp)
+                return None
+        return ctypes.CDLL(lib_path)
+    except (OSError, AttributeError):
+        return None
+
+
 def load_meteor() -> Optional[ctypes.CDLL]:
     """Compile (once) and load the native METEOR library; None if the
     toolchain is unavailable."""
@@ -43,21 +64,10 @@ def load_meteor() -> Optional[ctypes.CDLL]:
     if _LIB is not None or _TRIED:
         return _LIB
     _TRIED = True
+    lib = _load_lib("meteor.cpp", "libmeteor.so")
+    if lib is None:
+        return None
     try:
-        lib_path = os.path.join(_HERE, "libmeteor.so")
-        if not os.path.exists(lib_path) or os.path.getmtime(lib_path) < os.path.getmtime(
-            os.path.join(_HERE, "meteor.cpp")
-        ):
-            # build into a temp file first so concurrent workers never load a
-            # half-written library
-            fd, tmp = tempfile.mkstemp(suffix=".so", dir=_HERE)
-            os.close(fd)
-            if _build(tmp):
-                os.replace(tmp, lib_path)
-            else:
-                os.unlink(tmp)
-                return None
-        lib = ctypes.CDLL(lib_path)
         lib.meteor_score_c.argtypes = [ctypes.c_char_p, ctypes.c_char_p, ctypes.c_int]
         lib.meteor_score_c.restype = ctypes.c_double
         # feed the synonym table (single source of truth shared with the
@@ -78,6 +88,37 @@ def load_meteor() -> Optional[ctypes.CDLL]:
         # pure-Python scorer is the always-available fallback
         return None
     return _LIB
+
+
+_COLLATE_LIB: Optional[ctypes.CDLL] = None
+_COLLATE_TRIED = False
+
+
+def load_collate() -> Optional[ctypes.CDLL]:
+    """The fused batch-collate kernel (collate.cpp); None when the
+    toolchain is unavailable or ``CSAT_TPU_NO_NATIVE_COLLATE=1``."""
+    global _COLLATE_LIB, _COLLATE_TRIED
+    if _COLLATE_LIB is not None or _COLLATE_TRIED:
+        return _COLLATE_LIB
+    _COLLATE_TRIED = True
+    if os.environ.get("CSAT_TPU_NO_NATIVE_COLLATE", "") == "1":
+        return None
+    lib = _load_lib("collate.cpp", "libcollate.so", opt="-O3")
+    if lib is None:
+        return None
+    try:
+        lib.collate_rel_c.argtypes = [
+            ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p,
+            ctypes.c_int64, ctypes.c_int64,
+            ctypes.c_int32, ctypes.c_int32,
+            ctypes.c_void_p, ctypes.c_void_p,
+            ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p,
+        ]
+        lib.collate_rel_c.restype = None
+    except AttributeError:
+        return None
+    _COLLATE_LIB = lib
+    return _COLLATE_LIB
 
 
 def native_meteor_score(hyp: str, ref: str, version: str = "1.5") -> Optional[float]:

@@ -153,3 +153,69 @@ def test_host_sharded_loader(synthetic_corpus, tiny_config):
     b1 = list(iterate_batches(ds, 4, shuffle=False, num_shards=2, shard_index=1))
     assert len(b0) == len(b1) == 3  # 24 samples / 2 shards / batch 4
     assert not np.array_equal(b0[0].src_seq, b1[0].src_seq)
+
+
+def test_native_collate_matches_numpy():
+    """The fused C++ collate kernel (native/collate.cpp) must be
+    bit-identical to the NumPy path — gather, mask-before-offset, clamp
+    boundaries, |L|<=1 adjacency — including distances that clip at both
+    ends of the embedding table."""
+    from csat_tpu.data.dataset import collate, collate_indexed
+    from csat_tpu.native import load_collate
+
+    if load_collate() is None:
+        import pytest
+
+        pytest.skip("native toolchain unavailable")
+
+    rng = np.random.default_rng(0)
+    s, n, max_src_len = 12, 24, 24
+    arrays = {
+        "src_seq": rng.integers(0, 50, (s, n)).astype(np.int32),
+        "tgt_seq": rng.integers(0, 50, (s, 7)).astype(np.int32),
+        "target": rng.integers(0, 50, (s, 7)).astype(np.int32),
+        # raw distances far beyond the clip range in both directions
+        "L_raw": rng.integers(-40, 40, (s, n, n)).astype(np.int16),
+        "T_raw": rng.integers(-40, 40, (s, n, n)).astype(np.int16),
+        "num_node": rng.integers(1, n, (s,)).astype(np.int32),
+        "tree_pos": rng.random((s, n, 32)).astype(np.float32),
+        "triplet": rng.integers(0, 30, (s, n)).astype(np.int32),
+    }
+    # make sure exact zeros (mask) and ±1 (adjacency) cases exist
+    arrays["L_raw"][:, 0, :3] = [0, 1, -1]
+    arrays["T_raw"][:, 0, 0] = 0
+
+    idx = np.asarray([3, 0, 7, 7, 11])
+    ref = collate({k: v[idx] for k, v in arrays.items()}, max_src_len)
+    out = collate_indexed(arrays, idx, max_src_len)
+    for field in ref._fields:
+        a, b = getattr(ref, field), getattr(out, field)
+        assert a.dtype == b.dtype, field
+        np.testing.assert_array_equal(a, b, err_msg=field)
+
+
+def test_native_collate_guards_bad_indices():
+    """Negative / out-of-range indices must take NumPy semantics (wraparound
+    / IndexError), never the C kernel's raw pointer arithmetic."""
+    from csat_tpu.data.dataset import collate, collate_indexed
+
+    rng = np.random.default_rng(2)
+    s, n = 6, 8
+    arrays = {
+        "src_seq": rng.integers(0, 9, (s, n)).astype(np.int32),
+        "tgt_seq": rng.integers(0, 9, (s, 5)).astype(np.int32),
+        "target": rng.integers(0, 9, (s, 5)).astype(np.int32),
+        "L_raw": rng.integers(-5, 5, (s, n, n)).astype(np.int16),
+        "T_raw": rng.integers(-5, 5, (s, n, n)).astype(np.int16),
+        "num_node": rng.integers(1, n, (s,)).astype(np.int32),
+        "tree_pos": rng.random((s, n, 16)).astype(np.float32),
+        "triplet": rng.integers(0, 9, (s, n)).astype(np.int32),
+    }
+    neg = np.asarray([-1, 0])
+    ref = collate({k: v[neg] for k, v in arrays.items()}, n)
+    out = collate_indexed(arrays, neg, n)
+    np.testing.assert_array_equal(ref.L, out.L)
+    import pytest
+
+    with pytest.raises(IndexError):
+        collate_indexed(arrays, np.asarray([s]), n)  # out of range

@@ -1,0 +1,83 @@
+"""Host-side input-pipeline microbench: native fused collate vs NumPy.
+
+Times the per-batch collate (gather + mask + adjacency + offset/clamp of
+the (B,N,N) relation matrices plus the small-field gathers) at flagship
+dimensions — the work the host must keep ahead of the device step for the
+prefetch pipeline (csat_tpu/train/loop.py) to hide it.
+
+    python tools/bench_collate.py [--samples 2000] [--batch 64] [--n 150]
+                                  [--iters 40]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from csat_tpu.data.dataset import collate, collate_indexed  # noqa: E402
+from csat_tpu.native import load_collate  # noqa: E402
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--samples", type=int, default=2000)
+    ap.add_argument("--batch", type=int, default=64)
+    ap.add_argument("--n", type=int, default=150)
+    ap.add_argument("--iters", type=int, default=40)
+    args = ap.parse_args()
+
+    rng = np.random.default_rng(0)
+    s, n = args.samples, args.n
+    arrays = {
+        "src_seq": rng.integers(0, 10_000, (s, n)).astype(np.int32),
+        "tgt_seq": rng.integers(0, 20_000, (s, 49)).astype(np.int32),
+        "target": rng.integers(0, 20_000, (s, 49)).astype(np.int32),
+        "L_raw": rng.integers(-90, 90, (s, n, n)).astype(np.int16),
+        "T_raw": rng.integers(-90, 90, (s, n, n)).astype(np.int16),
+        "num_node": rng.integers(1, n, (s,)).astype(np.int32),
+        "tree_pos": rng.random((s, n, 128)).astype(np.float32),
+        "triplet": rng.integers(0, 1246, (s, n)).astype(np.int32),
+    }
+    batches = [
+        rng.integers(0, s, (args.batch,)).astype(np.int64)
+        for _ in range(args.iters)
+    ]
+
+    def timed(fn):
+        fn(batches[0])  # warm
+        t0 = time.perf_counter()
+        for idx in batches:
+            fn(idx)
+        return (time.perf_counter() - t0) / len(batches)
+
+    numpy_s = timed(
+        lambda idx: collate({k: v[idx] for k, v in arrays.items()}, n)
+    )
+    native_available = load_collate() is not None
+    native_s = (
+        timed(lambda idx: collate_indexed(arrays, idx, n))
+        if native_available
+        else None
+    )
+    rec = {
+        "batch": args.batch,
+        "n": n,
+        "numpy_ms_per_batch": round(numpy_s * 1e3, 3),
+        "native_ms_per_batch": (
+            round(native_s * 1e3, 3) if native_s is not None else None
+        ),
+        "speedup": round(numpy_s / native_s, 2) if native_s else None,
+        "native_available": native_available,
+    }
+    print(json.dumps(rec))
+
+
+if __name__ == "__main__":
+    main()
