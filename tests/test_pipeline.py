@@ -78,9 +78,13 @@ def _sequential_reference(block, layer_params, x, pad, skeys, dkeys, n_micro,
     return out, sparsity
 
 
-@pytest.mark.parametrize("pipe,n_micro,data", [(4, 2, 2), (2, 4, 2), (4, 2, 1)])
-def test_wavefront_matches_sequential_microbatched(pipe, n_micro, data):
-    cfg = _tiny_cfg(pipeline_stages=pipe, pipeline_microbatches=n_micro)
+@pytest.mark.parametrize(
+    "pipe,n_micro,data,remat",
+    [(4, 2, 2, False), (2, 4, 2, False), (4, 2, 1, False), (2, 2, 2, True)],
+)
+def test_wavefront_matches_sequential_microbatched(pipe, n_micro, data, remat):
+    cfg = _tiny_cfg(pipeline_stages=pipe, pipeline_microbatches=n_micro,
+                    remat=remat)
     b, n, dmodel = 8, cfg.max_src_len, cfg.sbm_enc_dim
     rng = np.random.default_rng(0)
     x = jnp.asarray(rng.normal(size=(b, n, dmodel)), jnp.float32)
@@ -99,6 +103,9 @@ def test_wavefront_matches_sequential_microbatched(pipe, n_micro, data):
                                   rngs={"sample": sk})
         return y, sp
 
+    if remat:  # mirror the encoder's cfg.remat wrap (models/sbm.py)
+        block_apply = jax.checkpoint(block_apply)
+
     stacked = stack_layer_params(layer_params)
     with jax.sharding.set_mesh(mesh):
         assert pipeline_ready(pipe)
@@ -107,6 +114,24 @@ def test_wavefront_matches_sequential_microbatched(pipe, n_micro, data):
                 block_apply, s, xx, pp, skeys, None, n_micro, pipe
             )
         )(stacked, x, pad)
+
+        if remat:
+            # rematerialized backward must produce the same gradients as
+            # the stored-activation wavefront (checkpoint over the
+            # scan+ppermute transpose)
+            def loss_of(fn):
+                return jax.jit(jax.grad(
+                    lambda s: jnp.sum(gpipe_blocks(
+                        fn, s, x, pad, skeys, None, n_micro, pipe)[0] ** 2)
+                ))(stacked)
+
+            g_remat = loss_of(block_apply)
+            g_plain = loss_of(block_apply.__wrapped__)
+            jax.tree.map(
+                lambda a, b: np.testing.assert_allclose(
+                    np.asarray(a), np.asarray(b), rtol=1e-3, atol=1e-4),
+                g_remat, g_plain,
+            )
 
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref_out),
                                rtol=1e-5, atol=1e-5)
