@@ -61,14 +61,20 @@ class Batch(NamedTuple):
     src_seq: np.ndarray  # (B, N) int32 — AST token ids, PAD-padded
     tgt_seq: np.ndarray  # (B, T-1) int32 — decoder input (<s> ... )
     target: np.ndarray  # (B, T-1) int32 — decoder target ( ... </s>)
-    L: np.ndarray  # (B, N, N) int32 — offset ancestor distances
-    T: np.ndarray  # (B, N, N) int32 — offset sibling distances
+    L: np.ndarray  # (B, N, N) int16 — offset ancestor distances (< N < 2¹⁵)
+    T: np.ndarray  # (B, N, N) int16 — offset sibling distances
     L_mask: np.ndarray  # (B, N, N) bool — raw L == 0
     T_mask: np.ndarray  # (B, N, N) bool — raw T == 0
     num_node: np.ndarray  # (B,) int32
-    adj: np.ndarray  # (B, N, N) float32 — |L| <= 1 adjacency (laplacian PE)
-    tree_pos: np.ndarray  # (B, N, width*height) float32
+    adj: np.ndarray  # (B, N, N) uint8 — |L| <= 1 adjacency (laplacian PE)
+    tree_pos: np.ndarray  # (B, N, width*height) uint8 — one-hot chains
     triplet: np.ndarray  # (B, N) int32
+
+    # The (B,N,N)/(B,N,·) fields use the narrowest exact dtype so the
+    # host→HBM transfer per batch is minimized (at N=512 this halves the
+    # feed bytes); the model widens them ON DEVICE at its entry seam
+    # (models/csa_trans.py:decompress_batch) — a single fused cast, exact
+    # for these value ranges.
 
 
 def save_matrices(
@@ -181,6 +187,7 @@ class ASTDataset:
         cache_key = (
             f"N{config.max_src_len}_T{config.max_tgt_len}"
             f"_tp{config.tree_pos_width}x{config.tree_pos_height}_{config.lang}"
+            "_v2"  # v2: tree_pos stored uint8 (compressed device feed)
         )
         cache = os.path.join(split_dir, f"processed_data_{cache_key}.npz")
         if use_cache and os.path.exists(cache):
@@ -211,7 +218,7 @@ class ASTDataset:
             "L_raw": np.zeros((n_samples, N, N), np.int16),
             "T_raw": np.zeros((n_samples, N, N), np.int16),
             "num_node": np.zeros((n_samples,), np.int32),
-            "tree_pos": np.zeros((n_samples, N, cfg.tree_pos_width * cfg.tree_pos_height), np.float32),
+            "tree_pos": np.zeros((n_samples, N, cfg.tree_pos_width * cfg.tree_pos_height), np.uint8),
             "triplet": np.zeros((n_samples, N), np.int32),
         }
         for i in range(n_samples):
@@ -260,18 +267,18 @@ def collate(arrs: Dict[str, np.ndarray], max_src_len: int) -> Batch:
     T_raw = arrs["T_raw"].astype(np.int32)
     off = max_src_len // 2
     hi = max_src_len - 1
-    adj = (np.abs(L_raw) <= 1).astype(np.float32)  # L in {-1,0,1}
+    adj = (np.abs(L_raw) <= 1).astype(np.uint8)  # L in {-1,0,1}
     return Batch(
         src_seq=arrs["src_seq"].astype(np.int32),
         tgt_seq=arrs["tgt_seq"].astype(np.int32),
         target=arrs["target"].astype(np.int32),
-        L=np.clip(L_raw + off, 0, hi).astype(np.int32),
-        T=np.clip(T_raw + off, 0, hi).astype(np.int32),
+        L=np.clip(L_raw + off, 0, hi).astype(np.int16),
+        T=np.clip(T_raw + off, 0, hi).astype(np.int16),
         L_mask=L_raw == 0,
         T_mask=T_raw == 0,
         num_node=arrs["num_node"].astype(np.int32),
         adj=adj,
-        tree_pos=arrs["tree_pos"].astype(np.float32),
+        tree_pos=arrs["tree_pos"].astype(np.uint8),
         triplet=arrs["triplet"].astype(np.int32),
     )
 
@@ -307,11 +314,11 @@ def collate_indexed(
         return collate({k: v[idx] for k, v in arrays.items()}, max_src_len)
 
     b, n = len(idx64), L_all.shape[1]
-    L = np.empty((b, n, n), np.int32)
-    T = np.empty((b, n, n), np.int32)
+    L = np.empty((b, n, n), np.int16)
+    T = np.empty((b, n, n), np.int16)
     L_mask = np.empty((b, n, n), np.bool_)
     T_mask = np.empty((b, n, n), np.bool_)
-    adj = np.empty((b, n, n), np.float32)
+    adj = np.empty((b, n, n), np.uint8)
     lib.collate_rel_c(
         L_all.ctypes.data, T_all.ctypes.data, idx64.ctypes.data,
         b, n, max_src_len // 2, max_src_len - 1,
@@ -328,7 +335,7 @@ def collate_indexed(
         T_mask=T_mask,
         num_node=arrays["num_node"][idx64].astype(np.int32),
         adj=adj,
-        tree_pos=arrays["tree_pos"][idx64].astype(np.float32),
+        tree_pos=arrays["tree_pos"][idx64].astype(np.uint8),
         triplet=arrays["triplet"][idx64].astype(np.int32),
     )
 

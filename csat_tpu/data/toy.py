@@ -47,12 +47,12 @@ def random_batch(
         src_seq=src.astype(np.int32),
         tgt_seq=tgt.astype(np.int32),
         target=np.roll(tgt, -1, axis=1).astype(np.int32),
-        L=np.clip(raw_l + off, 0, hi).astype(np.int32),
-        T=np.clip(raw_t + off, 0, hi).astype(np.int32),
+        L=np.clip(raw_l + off, 0, hi).astype(np.int16),
+        T=np.clip(raw_t + off, 0, hi).astype(np.int16),
         L_mask=raw_l == 0,
         T_mask=raw_t == 0,
         num_node=np.full((batch_size,), n_real, np.int32),
-        adj=(np.abs(raw_l) <= 1).astype(np.float32),
-        tree_pos=(rng.random((batch_size, n, tp_dim)) < 0.1).astype(np.float32),
+        adj=(np.abs(raw_l) <= 1).astype(np.uint8),
+        tree_pos=(rng.random((batch_size, n, tp_dim)) < 0.1).astype(np.uint8),
         triplet=rng.integers(1, triplet_vocab_size, (batch_size, n)).astype(np.int32),
     )

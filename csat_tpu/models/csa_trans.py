@@ -51,6 +51,25 @@ Dtype = Any
 TRIPLET_VOCAB_FALLBACK = {"python": 1246, "java": 1505}
 
 
+def decompress_batch(batch: Batch) -> Batch:
+    """Widen the compressed host feed on device.
+
+    The collate emits the narrowest exact dtypes (int16 distances, uint8
+    adjacency / tree positions — ``data/dataset.py:Batch``) so the
+    host→HBM transfer is minimal; this single fused cast restores the
+    compute dtypes at the model boundary. Exact: every value fits the
+    narrow type by construction. Idempotent for already-wide batches
+    (``astype`` is identity on matching dtypes), so hand-built fp32/int32
+    test batches keep working.
+    """
+    return batch._replace(
+        L=batch.L.astype(jnp.int32),
+        T=batch.T.astype(jnp.int32),
+        adj=batch.adj.astype(jnp.float32),
+        tree_pos=batch.tree_pos.astype(jnp.float32),
+    )
+
+
 class CSATrans(nn.Module):
     cfg: Config
     src_vocab_size: int
@@ -96,6 +115,7 @@ class CSATrans(nn.Module):
     ):
         """→ (memory, sparsity_scalar, src_pe_expanded, graphs, attns)."""
         cfg = self.cfg
+        batch = decompress_batch(batch)  # widen the compressed host feed
         src_mask = batch.src_seq == PAD  # (B, N) True = pad
         src_emb = self.src_embedding(batch.src_seq, deterministic)
 
@@ -107,7 +127,7 @@ class CSATrans(nn.Module):
         elif cfg.use_pegen == "laplacian":
             src_pe = laplacian_pe(batch.adj, batch.num_node, cfg.pegen_dim).astype(self.dtype)
         elif cfg.use_pegen == "treepos":
-            src_pe = self.tree_pos_enc(batch.tree_pos.astype(jnp.float32)).astype(self.dtype)
+            src_pe = self.tree_pos_enc(batch.tree_pos).astype(self.dtype)
         elif cfg.use_pegen == "sequential":
             src_pe = None
         elif cfg.use_pegen == "triplet":

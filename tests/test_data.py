@@ -178,7 +178,7 @@ def test_native_collate_matches_numpy():
         "L_raw": rng.integers(-40, 40, (s, n, n)).astype(np.int16),
         "T_raw": rng.integers(-40, 40, (s, n, n)).astype(np.int16),
         "num_node": rng.integers(1, n, (s,)).astype(np.int32),
-        "tree_pos": rng.random((s, n, 32)).astype(np.float32),
+        "tree_pos": (rng.random((s, n, 32)) < 0.3).astype(np.uint8),
         "triplet": rng.integers(0, 30, (s, n)).astype(np.int32),
     }
     # make sure exact zeros (mask) and ±1 (adjacency) cases exist
@@ -208,7 +208,7 @@ def test_native_collate_guards_bad_indices():
         "L_raw": rng.integers(-5, 5, (s, n, n)).astype(np.int16),
         "T_raw": rng.integers(-5, 5, (s, n, n)).astype(np.int16),
         "num_node": rng.integers(1, n, (s,)).astype(np.int32),
-        "tree_pos": rng.random((s, n, 16)).astype(np.float32),
+        "tree_pos": (rng.random((s, n, 16)) < 0.3).astype(np.uint8),
         "triplet": rng.integers(0, 9, (s, n)).astype(np.int32),
     }
     neg = np.asarray([-1, 0])

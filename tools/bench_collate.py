@@ -42,7 +42,7 @@ def main() -> None:
         "L_raw": rng.integers(-90, 90, (s, n, n)).astype(np.int16),
         "T_raw": rng.integers(-90, 90, (s, n, n)).astype(np.int16),
         "num_node": rng.integers(1, n, (s,)).astype(np.int32),
-        "tree_pos": rng.random((s, n, 128)).astype(np.float32),
+        "tree_pos": (rng.random((s, n, 128)) < 0.1).astype(np.uint8),
         "triplet": rng.integers(0, 1246, (s, n)).astype(np.int32),
     }
     batches = [
@@ -66,9 +66,16 @@ def main() -> None:
         if native_available
         else None
     )
+    sample = collate_indexed(arrays, batches[0], n)
+    feed_bytes = sum(v.nbytes for v in sample)
+    wide_bytes = sum(
+        np.prod(v.shape) * (4 if v.dtype != np.bool_ else 1) for v in sample
+    )
     rec = {
         "batch": args.batch,
         "n": n,
+        "feed_bytes_per_batch": int(feed_bytes),
+        "uncompressed_bytes_per_batch": int(wide_bytes),
         "numpy_ms_per_batch": round(numpy_s * 1e3, 3),
         "native_ms_per_batch": (
             round(native_s * 1e3, 3) if native_s is not None else None
