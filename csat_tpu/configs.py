@@ -182,6 +182,13 @@ class Config:
                 )
         assert self.sbm_enc_dim % self.num_heads == 0
         assert len(self.clusters) == self.sbm_layers
+        # the compressed device feed ships offset distances as int16
+        # (data/dataset.py:Batch, native/collate.cpp) — beyond this bound
+        # they would wrap silently to negative gather indices
+        assert self.max_src_len < 2 ** 15, (
+            f"max_src_len={self.max_src_len} exceeds the int16 compressed "
+            "batch feed (see csat_tpu/data/dataset.py:Batch)"
+        )
         if self.pipeline_stages > 1:
             if self.sbm_layers % self.pipeline_stages:
                 raise ValueError(

@@ -378,9 +378,11 @@ class Trainer:
             )):
                 state, metrics = self.train_step(state, batch)
                 losses.append(metrics["loss"])
-                if it % 50 == 0:
+                if it % 50 == 0 and cfg.scalar_log:
                     # per-iteration scalar cadence mirrors the reference's
-                    # every-50-iters TensorBoard loss (train.py:212-217)
+                    # every-50-iters TensorBoard loss (train.py:212-217).
+                    # Gated on scalar_log so the float() device sync never
+                    # stalls the async dispatch pipeline when nobody reads it
                     self._scalar(epoch=epoch, it=it, loss=float(metrics["loss"]))
             if cfg.profile and epoch == start_epoch:
                 jax.block_until_ready(losses[-1])
