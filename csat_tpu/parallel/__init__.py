@@ -5,3 +5,8 @@ from csat_tpu.parallel.mesh import (  # noqa: F401
     replicated,
     shard_batch,
 )
+from csat_tpu.parallel.pipeline import (  # noqa: F401
+    gpipe_blocks,
+    pipeline_ready,
+    stack_layer_params,
+)
