@@ -68,6 +68,10 @@ run_capped() {
 say "probe"
 timeout 150 python bench.py --probe >> "$LOG" 2>&1 || { say "probe dead rc=$?"; exit 1; }
 
+# one archive per window: stale phase records from earlier windows would
+# otherwise ride along into this window's bench_results_tpu_*.jsonl copy
+: > .bench_results.jsonl
+
 # 1. bench variants, proven-first, ONE serve child per variant so an
 #    overrun never takes later variants down with it (soft budget 900 s,
 #    first compiles can exceed 600 s through the remote compiler)
