@@ -20,8 +20,9 @@ import orbax.checkpoint as ocp
 from csat_tpu.train.state import TrainState
 
 __all__ = [
-    "save_state", "restore_state", "restore_latest", "save_params",
-    "restore_params", "make_checkpoint_fn", "latest_step",
+    "save_state", "save_state_async", "wait_for_saves", "restore_state",
+    "restore_latest", "save_params", "restore_params", "make_checkpoint_fn",
+    "latest_step",
 ]
 
 
@@ -30,6 +31,69 @@ def _mgr(directory: str) -> ocp.CheckpointManager:
         os.path.abspath(directory),
         options=ocp.CheckpointManagerOptions(max_to_keep=3, create=True),
     )
+
+
+# Async epoch snapshots: one persistent manager per directory, saving in a
+# background thread while the next epoch trains (a blocking save stalls the
+# whole device for the d2h + serialize time). Trainer waits at fit() end.
+_ASYNC_MANAGERS: dict = {}
+
+
+def _mgr_async(directory: str) -> ocp.CheckpointManager:
+    d = os.path.abspath(directory)
+    m = _ASYNC_MANAGERS.get(d)
+    if m is None:
+        m = ocp.CheckpointManager(
+            d,
+            options=ocp.CheckpointManagerOptions(
+                max_to_keep=3, create=True, enable_async_checkpointing=True
+            ),
+        )
+        _ASYNC_MANAGERS[d] = m
+        import atexit
+
+        atexit.register(_close_async, d)
+    return m
+
+
+def _close_async(directory: str) -> None:
+    import sys
+
+    m = _ASYNC_MANAGERS.pop(directory, None)
+    if m is not None:
+        try:
+            m.wait_until_finished()
+            m.close()
+        except Exception as e:  # noqa: BLE001 — atexit: report, don't raise
+            print(f"# checkpoint: async save to {directory} failed at exit: "
+                  f"{type(e).__name__}: {e}", file=sys.stderr)
+
+
+def save_state_async(directory: str, state: TrainState, step: int) -> None:
+    """Snapshot whose slow half (orbax serialization + disk commit) runs in
+    a background thread while the next epoch trains.
+
+    The d2h fetch itself stays synchronous (``_to_host``): the train step
+    DONATES its state buffers, so the snapshot must be decoupled before
+    the next step reuses them, and host NumPy copies do that without the
+    device-side duplicate a ``jnp.copy`` would pin in HBM (memory-critical
+    long-AST configs run near capacity).
+
+    Durability contract: the save is durable only after
+    :func:`wait_for_saves` (Trainer calls it at the end of ``fit``; orbax
+    also drains the previous in-flight save before accepting a new one, so
+    at most the LAST snapshot can be lost to a hard kill — one
+    ``save_interval`` of resume window, never a corrupt checkpoint: orbax
+    commits steps atomically).
+    """
+    _mgr_async(directory).save(step, args=ocp.args.StandardSave(_to_host(state)))
+
+
+def wait_for_saves(directory: Optional[str] = None) -> None:
+    """Block until pending async snapshots are durable (all dirs, or one)."""
+    for d, m in list(_ASYNC_MANAGERS.items()):
+        if directory is None or d == os.path.abspath(directory):
+            m.wait_until_finished()
 
 
 def _to_host(tree: Any) -> Any:
@@ -101,9 +165,16 @@ def restore_params(directory: str, name: str = "best_model") -> Any:
 
 def make_checkpoint_fn(directory: str) -> Callable[[TrainState, int], None]:
     """Periodic-save hook for ``Trainer.fit`` (ref epoch snapshots,
-    ``train.py:194-198``)."""
+    ``train.py:194-198``) — async so the save never stalls the epoch loop;
+    ``Trainer._fit`` waits for durability before returning."""
+
+    ck_dir = os.path.join(directory, "checkpoints")
 
     def fn(state: TrainState, epoch: int) -> None:
-        save_state(os.path.join(directory, "checkpoints"), state, epoch)
+        save_state_async(ck_dir, state, epoch)
 
+    # scoped durability barrier: Trainer waits on THIS run's directory only
+    # (a process can host several trainers; an unscoped wait would serialize
+    # them on each other's snapshots)
+    fn.wait = lambda: wait_for_saves(ck_dir)
     return fn

@@ -413,6 +413,13 @@ class Trainer:
             if checkpoint_fn is not None and epoch % cfg.save_interval == 0:
                 checkpoint_fn(state, epoch)
             self.log(msg)
+        if checkpoint_fn is not None:
+            # epoch snapshots persist asynchronously (checkpoint.py) —
+            # make them durable before handing the state back; scoped to
+            # this run's directory when the hook provides it
+            from csat_tpu.train.checkpoint import wait_for_saves
+
+            getattr(checkpoint_fn, "wait", wait_for_saves)()
         if best_params is None and resumed and os.path.exists(best_meta):
             # resumed run that never beat the pre-kill best: the on-disk
             # best_model is still the winner (a FRESH run — including a

@@ -81,3 +81,23 @@ def test_kill_and_resume_reproduces_loss_curve(tiny_config, synthetic_corpus, tm
         hist_b["loss"], hist_a["loss"][2:], rtol=1e-6,
         err_msg="resumed continuation diverged from the uninterrupted curve",
     )
+
+
+def test_async_save_roundtrip(tmp_path, tiny_config):
+    """save_state_async + wait_for_saves must be restore-equivalent to the
+    blocking save (same on-disk format, donation-safe detached copies)."""
+    from csat_tpu.train.checkpoint import (
+        restore_state, save_state_async, wait_for_saves,
+    )
+
+    _, _, _, state, _ = _setup(tiny_config)
+    d = str(tmp_path / "ck_async")
+    save_state_async(d, state, step=2)
+    wait_for_saves(d)
+    restored = restore_state(d, state, step=2)
+    jax.tree.map(
+        lambda a, b: np.testing.assert_array_equal(np.asarray(a), np.asarray(b)),
+        jax.tree.map(np.asarray, state.params), restored.params,
+    )
+    assert int(restored.step) == int(state.step)
+    assert jax.random.key_data(restored.rng).tolist() == jax.random.key_data(state.rng).tolist()
