@@ -26,7 +26,7 @@ New TPU-specific axes (not present in the reference):
 from __future__ import annotations
 
 import dataclasses
-from typing import Optional, Tuple
+from typing import Tuple
 
 
 @dataclasses.dataclass(frozen=True)

@@ -24,7 +24,7 @@ Decode paths:
 
 from __future__ import annotations
 
-from typing import Any, Dict, Optional, Tuple
+from typing import Any, Dict, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -37,7 +37,6 @@ from csat_tpu.models.components import (
     Embeddings,
     Generator,
     make_std_mask,
-    subsequent_mask,
 )
 from csat_tpu.models.cse import CSE
 from csat_tpu.models.pe import TreePositionalEncodings, TripletEmbedding, laplacian_pe

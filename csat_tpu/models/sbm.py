@@ -38,7 +38,7 @@ import jax.numpy as jnp
 from flax import linen as nn
 
 from csat_tpu.configs import Config
-from csat_tpu.models.components import LN_EPS, XAVIER, dense, merge_heads, sinusoidal_table, split_heads
+from csat_tpu.models.components import LN_EPS, dense, merge_heads, sinusoidal_table, split_heads
 from csat_tpu.models.ste import bernoulli_noise, sample_graph
 
 Dtype = Any

@@ -60,7 +60,7 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 from csat_tpu.ops.hashrng import (
-    TILE, bits_to_uniform, hash_bits, noise_stride, round_up)
+    TILE, bits_to_uniform, hash_bits, round_up)
 from csat_tpu.ops.sbm_pallas import _interpret
 
 # TILE (the q/k tile edge, MXU/lane aligned) lives in hashrng — the hash

@@ -19,12 +19,11 @@ Two implementations:
 
 from __future__ import annotations
 
-from typing import Any, Dict, Optional
+from typing import Any
 
 import jax
 import jax.numpy as jnp
 
-from csat_tpu.configs import Config
 from csat_tpu.data.dataset import Batch
 from csat_tpu.models import CSATrans
 from csat_tpu.utils import BOS, PAD

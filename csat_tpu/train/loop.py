@@ -35,7 +35,7 @@ from csat_tpu.data.dataset import ASTDataset, Batch, iterate_batches
 from csat_tpu.data.vocab import Vocab, load_vocab
 from csat_tpu.metrics import batch_bleu, bleu_output_transform, eval_accuracies
 from csat_tpu.models import CSATrans
-from csat_tpu.parallel import build_mesh, replicated, shard_batch
+from csat_tpu.parallel import build_mesh, shard_batch
 from csat_tpu.train.decode import greedy_decode
 from csat_tpu.train.loss import label_smoothing_loss
 from csat_tpu.train.state import TrainState, create_train_state, default_optimizer, make_model

@@ -50,7 +50,6 @@ def main() -> None:
     # the axon plugin ignores the env var; only the config update reliably
     # keeps this CPU-mesh check off the (possibly wedged) TPU relay
     jax.config.update("jax_platforms", "cpu")
-    import jax.numpy as jnp
     import numpy as np
     from jax.sharding import NamedSharding, PartitionSpec as P
 
