@@ -70,6 +70,8 @@ def _sequential_reference(block, layer_params, x, pad, skeys, dkeys, n_micro,
                     {"params": p}, y, jnp.asarray(pr[s, m]), deterministic,
                     False, rngs=rngs,
                 )
+                if sp is None:  # dense family reports no sparsity
+                    sp = jnp.zeros((block.cfg.num_heads,), jnp.float32)
                 sps.append(sp)
             outs[s, m] = np.asarray(y)
             spars.append(jnp.stack(sps))  # (L, H)
@@ -170,6 +172,54 @@ def test_wavefront_with_dropout_matches_sequential():
 
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref_out),
                                rtol=1e-5, atol=1e-5)
+
+
+def test_wavefront_bf16_matches_sequential():
+    """bfloat16 blocks (the MXU dtype) through the wavefront, dense
+    (full_att) family: the SBM family is excluded because bf16
+    reassociation between scanned and straight-line HLO flips borderline
+    ``noise < expA`` Bernoulli draws — a sampling artifact, not a pipeline
+    defect (the f32 SBM equivalence above pins the wavefront math)."""
+    cfg = _tiny_cfg(pipeline_stages=2, pipeline_microbatches=2,
+                    compute_dtype="bfloat16", full_att=True)
+    b, n, dmodel = 4, cfg.max_src_len, cfg.sbm_enc_dim
+    rng = np.random.default_rng(5)
+    x = jnp.asarray(rng.normal(size=(b, n, dmodel)), jnp.bfloat16)
+    pad = jnp.asarray(rng.random((b, n)) < 0.2)
+    block = SBMBlock(cfg, 0, jnp.bfloat16)
+    layer_params = [
+        block.init(
+            {"params": jax.random.key(200 + i), "sample": jax.random.key(0)},
+            x[:1], pad[:1], True, False,
+        )["params"]
+        for i in range(cfg.sbm_layers)
+    ]
+    skeys = jax.random.split(jax.random.key(9), (cfg.sbm_layers, 2))
+    ref_out, _ = _sequential_reference(
+        block, layer_params, x, pad, skeys, None, 2, True, n_data=2
+    )
+
+    def block_apply(p, xm, padm, sk, dk):
+        y, sp, _, _ = block.apply({"params": p}, xm, padm, True, False,
+                                  rngs={"sample": sk})
+        if sp is None:  # dense family reports no sparsity (encoder zero-fills)
+            sp = jnp.zeros((cfg.num_heads,), jnp.float32)
+        return y, sp
+
+    mesh = build_mesh((("data", 2), ("pipe", 2)))
+    with jax.sharding.set_mesh(mesh):
+        out, _ = jax.jit(
+            lambda s, xx, pp: gpipe_blocks(
+                block_apply, s, xx, pp, skeys, None, 2, 2
+            )
+        )(stack_layer_params(layer_params), x, pad)
+    assert out.dtype == jnp.bfloat16
+    # bf16 has ~3 decimal digits; scan-vs-straight-line HLO reassociation
+    # costs a few ulps per layer on O(1) activations
+    np.testing.assert_allclose(
+        np.asarray(out, np.float32), np.asarray(ref_out, np.float32),
+        rtol=5e-2, atol=6e-2,
+    )
 
 
 def test_pipeline_ready_gating():
