@@ -41,6 +41,12 @@ RUNS = {
         "results/real_stdlib/seq_h8/summary.json"],
     "torch reference (8 heads)": [
         "results/real_stdlib_torch/summary.json"],
+    # 24-epoch budget extension (2x): same corpus/dims/seed, both frameworks
+    "sbm f32 (8 heads, 24 epochs)": [
+        "outputs/r4e24/final_exp/real_stdlib_sbm_h8e24/summary.json",
+        "results/real_stdlib/sbm_h8e24/summary.json"],
+    "torch reference (8 heads, 24 epochs)": [
+        "results/real_stdlib_torch_e24/summary.json"],
 }
 
 
@@ -95,17 +101,20 @@ def main() -> None:
     ]
     if missing:
         out += ["", "Pending runs: " + ", ".join(missing)]
-    t = loaded.get("torch reference (8 heads)")
-    j = loaded.get("sbm f32 (8 heads, torch pair)")
-    if t and j:
-        tb = t["test_scores"]["bleu"]
-        jb = j["test_scores"]["bleu"] if isinstance(j.get("test_scores"), dict) else None
-        if isinstance(jb, (int, float)):
-            out += ["",
-                    f"**Framework delta (test BLEU, 8 heads): JAX {jb:.2f} vs "
-                    f"torch {tb:.2f} → {jb - tb:+.2f}** "
-                    f"(north-star target: within 0.1 at the reference's full "
-                    f"training scale; this is the same-budget CPU pairing)."]
+    for tl, jl, tag in (
+        ("torch reference (8 heads)", "sbm f32 (8 heads, torch pair)", "12-epoch"),
+        ("torch reference (8 heads, 24 epochs)", "sbm f32 (8 heads, 24 epochs)", "24-epoch"),
+    ):
+        t, j = loaded.get(tl), loaded.get(jl)
+        if t and j:
+            tb = t["test_scores"]["bleu"]
+            jb = j["test_scores"]["bleu"] if isinstance(j.get("test_scores"), dict) else None
+            if isinstance(jb, (int, float)):
+                out += ["",
+                        f"**Framework delta ({tag} budget, test BLEU, 8 heads): "
+                        f"JAX {jb:.2f} vs torch {tb:.2f} → {jb - tb:+.2f}** "
+                        f"(north-star target: within 0.1 at the reference's "
+                        f"full training scale; same-budget CPU pairing)."]
     print("\n".join(out))
     readme = os.path.join(REPO, "results", "real_stdlib", "README.md")
     with open(readme) as f:
