@@ -56,6 +56,9 @@ def main() -> None:
     p.add_argument("--floor", default="",
                    help="sbm_floor override (e.g. 0.0 lifts the reference's "
                         "0.01 Bernoulli clamp — the block-sparsity quirk-fix)")
+    p.add_argument("--seed", type=int, default=0,
+                   help="override cfg.seed (0 = config default 2021) — for "
+                        "seed-variance bounds on the paired BLEU tables")
     p.add_argument("--tag", default="",
                    help="suffix for the task/output dir (keeps ablation runs "
                         "from clobbering each other)")
@@ -106,6 +109,8 @@ def main() -> None:
         dims["compute_dtype"] = args.compute_dtype
     if args.floor:
         dims["sbm_floor"] = float(args.floor)
+    if args.seed:
+        dims["seed"] = args.seed
     tag = f"_{args.tag}" if args.tag else ""
     cfg = get_config(
         name,
