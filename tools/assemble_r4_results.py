@@ -49,8 +49,11 @@ RUNS = {
         "results/real_stdlib_torch_e24/summary.json"],
     # seed-variance bound for the pairing (12-epoch budget, seed 7)
     "sbm f32 (8 heads, 12 epochs, seed 7)": [
-        "outputs/r4s7/final_exp/real_stdlib_sbm_h8s7/summary.json",
         "results/real_stdlib/sbm_h8s7/summary.json"],
+    # the same seed-7 run resumed to 24 epochs (two-seed budget scaling)
+    "sbm f32 (8 heads, 24 epochs, seed 7)": [
+        "outputs/r4s7/final_exp/real_stdlib_sbm_h8s7/summary.json",
+        "results/real_stdlib/sbm_h8s7e24/summary.json"],
 }
 
 
