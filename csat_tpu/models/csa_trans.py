@@ -79,14 +79,17 @@ class CSATrans(nn.Module):
     def setup(self):
         cfg = self.cfg
         self.src_embedding = Embeddings(
-            self.src_vocab_size, cfg.src_emb_dim, cfg.dropout, with_pos=False, dtype=self.dtype
+            self.src_vocab_size, cfg.src_emb_dim, cfg.dropout, with_pos=False,
+            dtype=self.dtype, pad_row=cfg.pad_row,
         )
         self.tgt_embedding = Embeddings(
-            self.tgt_vocab_size, cfg.hidden_size, cfg.dropout, with_pos=True, dtype=self.dtype
+            self.tgt_vocab_size, cfg.hidden_size, cfg.dropout, with_pos=True,
+            dtype=self.dtype, pad_row=cfg.pad_row,
         )
         if cfg.use_pegen == "pegen":
             self.src_pe_embedding = Embeddings(
-                self.src_vocab_size, cfg.pegen_dim, cfg.dropout, with_pos=False, dtype=self.dtype
+                self.src_vocab_size, cfg.pegen_dim, cfg.dropout, with_pos=False,
+                dtype=self.dtype, pad_row=cfg.pad_row,
             )
             self.pegen = CSE(cfg, self.dtype)
         elif cfg.use_pegen == "treepos":

@@ -139,14 +139,20 @@ def gpipe_blocks(
         else:  # pragma: no cover
             buf0 = jax.lax.pvary(zeros, "pipe")
         _, (ys, sps) = jax.lax.scan(tick, buf0, jnp.arange(ticks))
-        # the last stage's outputs at ticks P-1 .. T-1 are microbatches 0..M-1
-        is_last = (r == n_stages - 1).astype(ys.dtype)
-        out = jax.lax.psum(ys * is_last, "pipe")[n_stages - 1:]
+        # the last stage's outputs at ticks P-1 .. T-1 are microbatches 0..M-1.
+        # select (not multiply): bubble ticks stream garbage activations
+        # through real blocks, and 0·NaN would leak NaN into valid outputs
+        out = jax.lax.psum(
+            jnp.where(r == n_stages - 1, ys, jnp.zeros_like(ys)), "pipe"
+        )[n_stages - 1:]
         out = out.reshape(b_loc, *x_loc.shape[1:])
-        # stage r's valid ticks are [r, r+M); microbatch-mean == batch value
+        # stage r's valid ticks are [r, r+M); microbatch-mean == batch value.
+        # same NaN-safety select as `out` above
         tt = jnp.arange(ticks)
-        valid = ((tt >= r) & (tt < r + n_micro)).astype(sps.dtype)
-        sp_loc = (sps * valid[:, None, None]).sum(0) / n_micro  # (L/P, H)
+        valid = (tt >= r) & (tt < r + n_micro)
+        sp_loc = jnp.where(
+            valid[:, None, None], sps, jnp.zeros_like(sps)
+        ).sum(0) / n_micro  # (L/P, H)
         if has_data:
             sp_loc = jax.lax.pmean(sp_loc, "data")
         # assemble the full (L, H) via zero-pad + psum (psum's replication

@@ -56,17 +56,39 @@ def _mgr_async(directory: str) -> ocp.CheckpointManager:
     return m
 
 
+_ATEXIT_DRAIN_S = 120.0  # bound the exit-time drain: a wedged async save
+# (the hung-RPC failure mode results/perf/tpu_session_r4.md documents) must
+# not hang interpreter exit forever
+
+
 def _close_async(directory: str) -> None:
     import sys
+    import threading
 
     m = _ASYNC_MANAGERS.pop(directory, None)
-    if m is not None:
+    if m is None:
+        return
+
+    def drain() -> None:
+        # errors are reported HERE: the spawning thread's join() never
+        # re-raises, so an unguarded body would dump a bare traceback via
+        # threading's excepthook with no directory context
         try:
             m.wait_until_finished()
             m.close()
         except Exception as e:  # noqa: BLE001 — atexit: report, don't raise
             print(f"# checkpoint: async save to {directory} failed at exit: "
                   f"{type(e).__name__}: {e}", file=sys.stderr)
+
+    t = threading.Thread(target=drain, daemon=True)
+    t.start()
+    t.join(_ATEXIT_DRAIN_S)
+    if t.is_alive():
+        print(f"# checkpoint: async save to {directory} still pending "
+              f"after {_ATEXIT_DRAIN_S:.0f}s at exit — abandoning (the "
+              "last snapshot may be incomplete; orbax commits steps "
+              "atomically so no corrupt checkpoint is left behind)",
+              file=sys.stderr)
 
 
 def save_state_async(directory: str, state: TrainState, step: int) -> None:
@@ -104,23 +126,40 @@ def _to_host(tree: Any) -> Any:
     )
 
 
+def _sync_mgr(directory: str):
+    """→ ``(manager, owns_it)`` for a synchronous operation on ``directory``.
+
+    If a live async manager exists for the directory, drain and REUSE it —
+    two independent managers (each with its own ``max_to_keep=3`` GC) over
+    one directory can race deletions in mixed-use processes. The caller
+    closes the manager only when it owns it (``owns_it``)."""
+    d = os.path.abspath(directory)
+    m = _ASYNC_MANAGERS.get(d)
+    if m is not None:
+        m.wait_until_finished()
+        return m, False
+    return _mgr(d), True
+
+
 def save_state(directory: str, state: TrainState, step: int) -> None:
-    mgr = _mgr(directory)
+    mgr, owned = _sync_mgr(directory)
     host_state = _to_host(state)
     mgr.save(step, args=ocp.args.StandardSave(host_state))
     mgr.wait_until_finished()
-    mgr.close()
+    if owned:
+        mgr.close()
 
 
 def restore_state(directory: str, example: TrainState, step: Optional[int] = None) -> TrainState:
     """Restore into the structure of ``example`` (params/opt_state shapes must
     match). The stored PRNG key data is rewrapped into a typed key."""
-    mgr = _mgr(directory)
+    mgr, owned = _sync_mgr(directory)
     step = step if step is not None else mgr.latest_step()
     assert step is not None, f"no checkpoints under {directory}"
     host_example = _to_host(example)
     restored = mgr.restore(step, args=ocp.args.StandardRestore(host_example))
-    mgr.close()
+    if owned:
+        mgr.close()
     rng = jax.random.wrap_key_data(restored.rng)
     return TrainState(
         step=restored.step, params=restored.params, opt_state=restored.opt_state, rng=rng
@@ -131,9 +170,10 @@ def latest_step(directory: str) -> Optional[int]:
     """Latest checkpointed step/epoch under ``directory``, or None."""
     if not os.path.isdir(directory):
         return None
-    mgr = _mgr(directory)
+    mgr, owned = _sync_mgr(directory)
     step = mgr.latest_step()
-    mgr.close()
+    if owned:
+        mgr.close()
     return step
 
 

@@ -369,6 +369,48 @@ def test_full_forward_parity(ref, cfg, batch, torch_model, flax_model, monkeypat
     np.testing.assert_allclose(np.asarray(out_f), t2n(out_t), atol=1e-4)
 
 
+def test_frozen_pad_row_parity(ref, cfg, batch, torch_model, monkeypatch):
+    """``pad_row="frozen"`` reproduces the reference bit-for-bit on PADDED
+    batches. The reference declares ``padding_idx=0`` but its global xavier
+    re-init overwrites the zero row and padding_idx then freezes the garbage
+    (``csa_trans.py:166-168``); padded positions carry that fixed random
+    vector and it leaks into real-position outputs through the unmasked
+    attention paths. ``pad_row="zero"`` (the r1–r4 default) measurably
+    deviates on such batches (ΔNLL ≈ 0.012 at init on the real corpus —
+    ``tools/step0_probe.py``)."""
+    from csat_tpu.data.toy import random_batch
+    from csat_tpu.train.state import make_model
+
+    pb = random_batch(cfg, B, SRC_V, TGT_V, seed=19, n_real_nodes=N - 5)
+    tgt = np.asarray(pb.tgt_seq).copy()
+    tgt[:, -2:] = 0  # padded target tail exercises tgt_embedding's PAD row
+    target = np.roll(tgt, -1, axis=1)
+    target[:, -1] = 0
+    pb = pb._replace(tgt_seq=tgt, target=target)
+
+    noises = shared_noise(SBM_LAYERS, seed=29)
+    d = torch_data(pb, ref)
+    patch_bernoulli(monkeypatch, noises)
+    with torch.no_grad():
+        out_t, sp_t, _, _, _ = torch_model(d)
+
+    params = full_params(torch_model.state_dict())
+    fm = make_model(cfg.replace(pad_row="frozen"), SRC_V, TGT_V)
+    patch_flax_noise(monkeypatch, noises)
+    out_f, sp_f, _, _, _ = fm.apply(
+        {"params": params}, pb, rngs={"sample": jax.random.key(0)})
+    np.testing.assert_allclose(float(sp_f), float(sp_t), atol=1e-6)
+    np.testing.assert_allclose(np.asarray(out_f), t2n(out_t), atol=1e-4)
+
+    # the "zero" mode must deviate on the same padded batch — otherwise the
+    # quirk flag would be dead weight
+    fm_zero = make_model(cfg, SRC_V, TGT_V)
+    patch_flax_noise(monkeypatch, noises)
+    out_z, _, _, _, _ = fm_zero.apply(
+        {"params": params}, pb, rngs={"sample": jax.random.key(0)})
+    assert float(np.max(np.abs(np.asarray(out_z) - t2n(out_t)))) > 1e-5
+
+
 def test_greedy_decode_parity(ref, cfg, batch, torch_model, flax_model, monkeypatch):
     """Greedy decode emits token-identical sequences (KV-cache scan vs the
     reference's full-prefix re-run)."""

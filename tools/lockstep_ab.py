@@ -1,0 +1,251 @@
+"""Deterministic lockstep A/B: JAX stack vs torch reference, same init.
+
+VERDICT r4 #2 root-cause harness for the 24-epoch BLEU gap. The module
+parity tests prove single-forward agreement; this tool proves (or refutes)
+*whole-training-step* agreement over hundreds of optimizer steps:
+
+* the torch reference model (imported from ``/root/reference`` at runtime,
+  nothing copied) is built at the paired dims and its *initial* state_dict
+  is ported to flax params with the same converters the parity tests use
+  (``tests/test_reference_parity.py:111-222``);
+* both frameworks run in no-dropout mode (torch ``.eval()``, flax
+  ``deterministic=True`` — the reference hardcodes several 0.2 dropouts
+  that a ``dropout=0`` constructor arg does not reach, so eval mode is the
+  only way to switch them all off);
+* the STE Bernoulli draw is the one remaining stochastic op; both sides
+  are patched to consume the SAME uniform noise per (step, layer) —
+  torch via ``torch.bernoulli`` monkeypatch (the parity tests' trick),
+  flax by threading the noise arrays through the jitted step as real
+  arguments (trace-time pop binds each ``bernoulli_noise`` call site to an
+  argument position);
+* both sides take AdamW(correct_bias=False, lr) steps on the same batch
+  sequence (same shuffle seeds as the real paired runs).
+
+Output: per-step |Δloss|, plus a final per-tensor drift table (torch
+params converted to the flax tree and diffed leaf-by-leaf) that localizes
+any divergence to the first op whose gradient disagrees.
+
+    python tools/lockstep_ab.py --data_dir ./data/stdlib_python --steps 150
+"""
+
+from __future__ import annotations
+
+import argparse
+import importlib.util
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+
+def _load_parity_helpers():
+    spec = importlib.util.spec_from_file_location(
+        "parity_helpers", os.path.join(REPO, "tests", "test_reference_parity.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def main() -> None:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--data_dir", required=True)
+    p.add_argument("--steps", type=int, default=150)
+    p.add_argument("--batch_size", type=int, default=32)
+    p.add_argument("--learning_rate", type=float, default=3e-4)
+    p.add_argument("--out", default="./results/lockstep")
+    p.add_argument("--variant", choices=["sbm", "full_att"], default="sbm")
+    p.add_argument("--zero_pad", action="store_true",
+                   help="zero the torch PAD embedding rows at init so both "
+                        "frameworks compute the same function (isolates the "
+                        "frozen-garbage-PAD-row quirk, tools/step0_probe.py)")
+    args = p.parse_args()
+
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    import torch
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    import jax.numpy as jnp
+
+    from tools.train_torch_real import _import_reference
+
+    ref_module, ref_utils, ref_optimizer = _import_reference()
+    ph = _load_parity_helpers()  # torch→flax converters (plain functions)
+
+    from csat_tpu.configs import get_config
+    from csat_tpu.data.dataset import ASTDataset, iterate_batches
+    from csat_tpu.data.vocab import load_vocab
+    from csat_tpu.train.loss import label_smoothing_loss
+    from csat_tpu.train.optimizer import adamw
+    from csat_tpu.train.state import make_model
+
+    full_att = args.variant == "full_att"
+    name = "python_full_att" if full_att else "python"
+    cfg = get_config(
+        name, data_dir=args.data_dir, batch_size=args.batch_size,
+        pe_dim=64, pegen_dim=128, sbm_enc_dim=128, hidden_size=128,
+        num_heads=8, num_layers=2, sbm_layers=2, clusters=(8, 8),
+        dim_feed_forward=512, max_tgt_len=30,
+    )
+    src_vocab, tgt_vocab = load_vocab(cfg.data_dir)
+    train_ds = ASTDataset(cfg, "train", src_vocab, tgt_vocab)
+
+    torch.manual_seed(cfg.seed)
+    tmodel = ref_module.csa_trans.CSATrans(
+        src_vocab_size=src_vocab.size(), tgt_vocab_size=tgt_vocab.size(),
+        hidden_size=cfg.hidden_size, num_heads=cfg.num_heads,
+        num_layers=cfg.num_layers, sbm_layers=cfg.sbm_layers,
+        use_pegen="pegen", dim_feed_forward=cfg.dim_feed_forward,
+        dropout=cfg.dropout, pe_dim=cfg.pe_dim, pegen_dim=cfg.pegen_dim,
+        sbm_enc_dim=cfg.sbm_enc_dim, clusters=list(cfg.clusters),
+        full_att=full_att, max_src_len=cfg.max_src_len,
+    )
+    tmodel.eval()  # all dropouts off; STE still samples (forward is ungated)
+    if args.zero_pad:
+        with torch.no_grad():
+            for emb in (tmodel.src_embedding, tmodel.src_pe_embedding,
+                        tmodel.tgt_embedding):
+                emb.word_embeddings.weight[0].zero_()
+
+    def full_params(sd):
+        pp = {
+            "src_embedding": ph._emb(sd, "src_embedding"),
+            "tgt_embedding": ph._emb(sd, "tgt_embedding"),
+            "src_pe_embedding": ph._emb(sd, "src_pe_embedding"),
+            "pegen": ph.cse_params(sd, cfg.num_layers),
+            "encoder": ph.sbm_params(sd, cfg.sbm_layers, full_att=full_att),
+            "decoder": ph.decoder_params(sd, cfg.decoder_layers, cfg.hidden_size),
+            "generator": {"Dense_0": ph._lin(sd, "generator.linear")},
+        }
+        return pp
+
+    # force real copies: t2n returns views over torch's live storage, and
+    # CPU jnp.asarray can be zero-copy — without the copy the "initial" JAX
+    # params would silently track torch's in-place optimizer updates
+    params = jax.tree.map(lambda a: jnp.array(np.array(a, copy=True)),
+                          full_params(tmodel.state_dict()))
+    fmodel = make_model(cfg, src_vocab.size(), tgt_vocab.size())
+
+    tx = adamw(args.learning_rate, correct_bias=False)
+    opt_state = tx.init(params)
+    topt = ref_optimizer.AdamW(
+        tmodel.parameters(), lr=args.learning_rate, correct_bias=False)
+    criterion = ref_utils.label_smooth.LabelSmoothing(
+        padding_idx=0, smoothing=cfg.smoothing)
+
+    # ---- shared-noise plumbing -------------------------------------------
+    b, h, n = cfg.batch_size, cfg.num_heads, cfg.max_src_len
+    n_draws = 0 if full_att else cfg.sbm_layers
+    noise_rng = np.random.default_rng(123)
+
+    # flax: bernoulli_noise pops the jitted step's noise *tracers* at trace
+    # time, turning each call site into a real function argument
+    import csat_tpu.models.sbm as sbm_mod
+
+    _override = []
+    sbm_mod.bernoulli_noise = lambda key, shape: _override.pop(0)
+
+    # torch: same values via the parity tests' bernoulli monkeypatch
+    _tnoise = []
+    torch.bernoulli = lambda t: (torch.from_numpy(_tnoise.pop(0)) < t).float()
+
+    def loss_fn(params, batch, noises):
+        _override[:] = list(noises)
+        log_probs, sparsity, _, _, _ = fmodel.apply(
+            {"params": params}, batch, deterministic=True,
+            rngs={"sample": jax.random.key(0)},
+        )
+        nll = label_smoothing_loss(log_probs, batch.target, cfg.smoothing)
+        return nll + cfg.sw * sparsity, nll
+
+    import functools
+
+    import optax
+
+    @functools.partial(jax.jit, donate_argnums=(0, 1))
+    def jstep(params, opt_state, batch, noises):
+        (total, nll), grads = jax.value_and_grad(loss_fn, has_aux=True)(
+            params, batch, noises)
+        updates, opt_state = tx.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        return params, opt_state, total, nll
+
+    def to_torch(batch):
+        import types as _t
+
+        d = _t.SimpleNamespace()
+        for f in ("src_seq", "tgt_seq", "L", "T", "num_node", "triplet"):
+            setattr(d, f, torch.from_numpy(np.asarray(getattr(batch, f))).long())
+        for f in ("L_mask", "T_mask", "adj", "tree_pos"):
+            setattr(d, f, torch.from_numpy(np.asarray(getattr(batch, f))))
+        return d, torch.from_numpy(np.asarray(batch.target)).long()
+
+    os.makedirs(args.out, exist_ok=True)
+    rec = {"steps": [], "dims": {"b": b, "h": h, "n": n}, "variant": args.variant}
+    step = 0
+    epoch = 0
+    t0 = time.time()
+    done = False
+    while not done:
+        for batch in iterate_batches(train_ds, cfg.batch_size, shuffle=True,
+                                     seed=cfg.seed + 1 + epoch):
+            noises = [noise_rng.uniform(size=(b, h, n, n)).astype(np.float32)
+                      for _ in range(n_draws)]
+            # torch side first (it mutates _tnoise)
+            _tnoise[:] = [x.copy() for x in noises]
+            d, target = to_torch(batch)
+            out, tsp, _, _, _ = tmodel(d)
+            tnll = criterion(out.reshape(-1, out.size(-1)), target.reshape(-1))
+            tloss = tnll + cfg.sw * tsp
+            topt.zero_grad()
+            tloss.backward()
+            topt.step()
+
+            params, opt_state, jtotal, jnll = jstep(
+                params, opt_state, batch, [jnp.asarray(x) for x in noises])
+            jt, tt = float(jtotal), float(tloss.detach())
+            rec["steps"].append(
+                {"step": step, "jax": round(jt, 6), "torch": round(tt, 6),
+                 "adiff": round(abs(jt - tt), 6)})
+            if step % 10 == 0:
+                print(f"step {step}: jax {jt:.5f} torch {tt:.5f} "
+                      f"|Δ| {abs(jt - tt):.2e} ({time.time() - t0:.0f}s)",
+                      flush=True)
+            step += 1
+            if step >= args.steps:
+                done = True
+                break
+        epoch += 1
+
+    # ---- final per-tensor drift table ------------------------------------
+    tparams = jax.tree.map(jnp.asarray, full_params(tmodel.state_dict()))
+    flat_j = jax.tree_util.tree_flatten_with_path(params)[0]
+    flat_t = jax.tree_util.tree_flatten_with_path(tparams)[0]
+    drift = []
+    for (pj, vj), (pt, vt) in zip(flat_j, flat_t):
+        name = "/".join(str(getattr(k, "key", k)) for k in pj)
+        denom = float(jnp.max(jnp.abs(vt))) or 1.0
+        drift.append((name, float(jnp.max(jnp.abs(vj - vt))) / denom))
+    drift.sort(key=lambda kv: -kv[1])
+    rec["param_drift_top"] = [
+        {"tensor": k, "max_rel_diff": round(v, 8)} for k, v in drift[:15]]
+    rec["param_drift_median"] = float(np.median([v for _, v in drift]))
+    rec["wall_s"] = round(time.time() - t0, 1)
+    tag = f"{args.variant}_zp" if args.zero_pad else args.variant
+    rec["zero_pad"] = args.zero_pad
+    with open(os.path.join(args.out, f"lockstep_{tag}.json"), "w") as f:
+        json.dump(rec, f, indent=1)
+    last = rec["steps"][-1]
+    print(json.dumps({"final_adiff": last["adiff"],
+                      "median_drift": rec["param_drift_median"],
+                      "top_drift": rec["param_drift_top"][:3]}))
+
+
+if __name__ == "__main__":
+    main()

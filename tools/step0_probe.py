@@ -1,0 +1,143 @@
+"""Step-0 loss decomposition: where does the ported-init forward differ?
+
+Companion to tools/lockstep_ab.py. Runs ONE real padded batch through both
+frameworks at identical (ported) params with shared Bernoulli noise, and
+prints (nll, sparsity) per framework — then repeats with the torch PAD
+embedding rows zeroed, to attribute the delta to the reference's frozen
+garbage-PAD-row quirk (torch ``padding_idx=0`` + global xavier re-init,
+ref ``csa_trans.py:166-168`` + ``components.py:28``).
+"""
+
+from __future__ import annotations
+
+import importlib.util
+import json
+import os
+import sys
+
+import numpy as np
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+import torch  # noqa: E402
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+import jax.numpy as jnp  # noqa: E402
+
+from tools.train_torch_real import _import_reference, _to_torch  # noqa: E402
+
+ref_module, ref_utils, ref_optimizer = _import_reference()
+
+spec = importlib.util.spec_from_file_location(
+    "parity_helpers", os.path.join(REPO, "tests", "test_reference_parity.py"))
+ph = importlib.util.module_from_spec(spec)
+spec.loader.exec_module(ph)
+
+from csat_tpu.configs import get_config  # noqa: E402
+from csat_tpu.data.dataset import ASTDataset, iterate_batches  # noqa: E402
+from csat_tpu.data.vocab import load_vocab  # noqa: E402
+from csat_tpu.train.loss import label_smoothing_loss  # noqa: E402
+from csat_tpu.train.state import make_model  # noqa: E402
+
+cfg = get_config(
+    "python", data_dir="./data/stdlib_python", batch_size=32,
+    pe_dim=64, pegen_dim=128, sbm_enc_dim=128, hidden_size=128,
+    num_heads=8, num_layers=2, sbm_layers=2, clusters=(8, 8),
+    dim_feed_forward=512, max_tgt_len=30,
+)
+src_vocab, tgt_vocab = load_vocab(cfg.data_dir)
+train_ds = ASTDataset(cfg, "train", src_vocab, tgt_vocab)
+batch = next(iterate_batches(train_ds, cfg.batch_size, shuffle=True,
+                             seed=cfg.seed + 1))
+
+torch.manual_seed(cfg.seed)
+tmodel = ref_module.csa_trans.CSATrans(
+    src_vocab_size=src_vocab.size(), tgt_vocab_size=tgt_vocab.size(),
+    hidden_size=cfg.hidden_size, num_heads=cfg.num_heads,
+    num_layers=cfg.num_layers, sbm_layers=cfg.sbm_layers,
+    use_pegen="pegen", dim_feed_forward=cfg.dim_feed_forward,
+    dropout=cfg.dropout, pe_dim=cfg.pe_dim, pegen_dim=cfg.pegen_dim,
+    sbm_enc_dim=cfg.sbm_enc_dim, clusters=list(cfg.clusters),
+    full_att=False, max_src_len=cfg.max_src_len,
+)
+tmodel.eval()
+criterion = ref_utils.label_smooth.LabelSmoothing(padding_idx=0,
+                                                  smoothing=cfg.smoothing)
+
+b, h, n = cfg.batch_size, cfg.num_heads, cfg.max_src_len
+noises = [np.random.default_rng(5).uniform(size=(b, h, n, n)).astype(np.float32)
+          for _ in range(cfg.sbm_layers)]
+
+_tnoise = []
+torch.bernoulli = lambda t: (torch.from_numpy(_tnoise.pop(0)) < t).float()
+
+import csat_tpu.models.sbm as sbm_mod  # noqa: E402
+
+_joverride = []
+sbm_mod.bernoulli_noise = lambda key, shape: jnp.asarray(_joverride.pop(0))
+
+
+def torch_fwd():
+    _tnoise[:] = [x.copy() for x in noises]
+    d, target = _to_torch(batch, torch)
+    with torch.no_grad():
+        out, sp, _, _, _ = tmodel(d)
+        nll = criterion(out.reshape(-1, out.size(-1)), target.reshape(-1))
+    return float(nll), float(sp)
+
+
+def full_params(sd):
+    return {
+        "src_embedding": ph._emb(sd, "src_embedding"),
+        "tgt_embedding": ph._emb(sd, "tgt_embedding"),
+        "src_pe_embedding": ph._emb(sd, "src_pe_embedding"),
+        "pegen": ph.cse_params(sd, cfg.num_layers),
+        "encoder": ph.sbm_params(sd, cfg.sbm_layers),
+        "decoder": ph.decoder_params(sd, cfg.decoder_layers, cfg.hidden_size),
+        "generator": {"Dense_0": ph._lin(sd, "generator.linear")},
+    }
+
+
+fmodel = make_model(cfg, src_vocab.size(), tgt_vocab.size())
+
+
+def jax_fwd(params):
+    _joverride[:] = [x.copy() for x in noises]
+    log_probs, sp, _, _, _ = fmodel.apply(
+        {"params": params}, batch, deterministic=True,
+        rngs={"sample": jax.random.key(0)})
+    nll = label_smoothing_loss(log_probs, batch.target, cfg.smoothing)
+    return float(nll), float(sp)
+
+
+t_nll, t_sp = torch_fwd()
+params = jax.tree.map(jnp.asarray, full_params(tmodel.state_dict()))
+j_nll, j_sp = jax_fwd(params)
+print(json.dumps({"torch": {"nll": t_nll, "sparsity": t_sp},
+                  "jax": {"nll": j_nll, "sparsity": j_sp}}))
+
+# pad_row="frozen" on the SAME garbage-row params must match orig torch
+cfg_frozen = cfg.replace(pad_row="frozen")
+fmodel_frozen = make_model(cfg_frozen, src_vocab.size(), tgt_vocab.size())
+_joverride[:] = [x.copy() for x in noises]
+log_probs, sp_f, _, _, _ = fmodel_frozen.apply(
+    {"params": params}, batch, deterministic=True,
+    rngs={"sample": jax.random.key(0)})
+j_nll_f = float(label_smoothing_loss(log_probs, batch.target, cfg.smoothing))
+print(json.dumps({"jax_frozen": {"nll": j_nll_f, "sparsity": float(sp_f)},
+                  "delta_vs_torch": round(abs(j_nll_f - t_nll), 8)}))
+
+# zero the PAD rows in torch (src, src_pe, tgt) and re-run both
+with torch.no_grad():
+    for emb in (tmodel.src_embedding, tmodel.src_pe_embedding,
+                tmodel.tgt_embedding):
+        emb.word_embeddings.weight[0].zero_()
+t_nll0, t_sp0 = torch_fwd()
+params0 = jax.tree.map(jnp.asarray, full_params(tmodel.state_dict()))
+j_nll0, j_sp0 = jax_fwd(params0)
+print(json.dumps({"pad_zeroed": {"torch": {"nll": t_nll0, "sparsity": t_sp0},
+                                 "jax": {"nll": j_nll0, "sparsity": j_sp0}}}))

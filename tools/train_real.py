@@ -65,6 +65,9 @@ def main() -> None:
     p.add_argument("--num_heads", type=int, default=0,
                    help="override head count (8 pairs with the torch "
                         "reference baseline, whose CSE hard-tiles 4+4 heads)")
+    p.add_argument("--pad_row", default="", choices=["", "zero", "frozen"],
+                   help="PAD-embedding-row mode (configs.Config.pad_row; "
+                        "'frozen' = reference-parity garbage row)")
     args = p.parse_args()
 
     os.environ["JAX_PLATFORMS"] = args.platform
@@ -111,6 +114,8 @@ def main() -> None:
         dims["sbm_floor"] = float(args.floor)
     if args.seed:
         dims["seed"] = args.seed
+    if args.pad_row:
+        dims["pad_row"] = args.pad_row
     tag = f"_{args.tag}" if args.tag else ""
     cfg = get_config(
         name,
