@@ -102,7 +102,7 @@ def _probe() -> None:
     }))
 
 
-def _measure_one(spec: str) -> dict:
+def _measure_one(spec: str, heartbeat=None) -> dict:
     """Measure one variant in the already-initialized backend session.
 
     spec = "backend:dtype:platform:batch:steps", platform "default" or "cpu".
@@ -136,8 +136,23 @@ def _measure_one(spec: str) -> dict:
     state = create_train_state(model, tx, batch, seed=cfg.seed)
     step = make_train_step(model, tx, cfg)
 
+    # AOT compile: same cost as the old first-call compile, but the static
+    # memory plan (the compile-time HBM budget on TPU) is on record BEFORE
+    # any step executes — a window that dies mid-step still leaves the
+    # memory evidence (r4 verdict #1: partial records per phase)
     t_compile = time.perf_counter()
-    state, metrics = step(state, batch)  # compile + warmup
+    step = step.lower(state, batch).compile()
+    from tools.xla_util import xla_mem as _xla_mem
+
+    mem = _xla_mem(step)
+    xla_mem = {k: mem[k] for k in ("xla_temp_gb", "xla_arg_gb") if k in mem}
+    if heartbeat is not None:
+        # compile-done evidence survives even if the relay dies before a
+        # single step completes (r4: window 1 closed mid-first-compile)
+        heartbeat({"phase": "compiled",
+                   "compile_s": round(time.perf_counter() - t_compile, 1),
+                   **xla_mem})
+    state, metrics = step(state, batch)  # warmup
     loss = float(jax.block_until_ready(metrics["loss"]))
     t_compile = time.perf_counter() - t_compile
     if not np.isfinite(loss):
@@ -169,6 +184,7 @@ def _measure_one(spec: str) -> dict:
         "step_ms": round(dt / n_steps * 1e3, 2),
         "peak_hbm_gb": round(peak / 2**30, 3),
         "nodes_per_sec_per_chip": nodes / dt / n_chips,
+        **xla_mem,
     }
 
 
@@ -224,7 +240,8 @@ def _serve(specs_csv: str, soft_budget_s: float) -> None:
             break
         emit({"phase": "start", "spec": spec, "left_s": round(left)})
         try:
-            rec = _measure_one(spec)
+            rec = _measure_one(
+                spec, heartbeat=lambda r, s=spec: emit({"spec": s, **r}))
             rec["spec"] = spec
             emit(rec)
         except Exception as e:  # noqa: BLE001 — record, keep going
@@ -238,14 +255,25 @@ def _serve(specs_csv: str, soft_budget_s: float) -> None:
 # parent: orchestration, hard timeouts, guaranteed JSON emission
 # --------------------------------------------------------------------------
 
-def _run_child(args, timeout_s: float):
-    """Run one child with a hard timeout, killing its whole process group."""
+def _run_child(args, timeout_s: float, cpu_only: bool = False):
+    """Run one child with a hard timeout, killing its whole process group.
+
+    ``cpu_only`` scrubs the axon-plugin env so the child interpreter never
+    loads the PJRT plugin at all: the baked sitecustomize registers it in
+    EVERY python process, and when the relay is half-dead its retry loop
+    hangs interpreter startup for minutes (observed r5) — which would
+    otherwise take down even the CPU fallback measurements."""
     if timeout_s <= 5:
         return None, "budget exhausted"
+    env = None
+    if cpu_only:
+        from tools.xla_util import cpu_child_env
+
+        env = cpu_child_env()
     proc = subprocess.Popen(
         [sys.executable, os.path.abspath(__file__), *args],
         stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
-        start_new_session=True, cwd=HERE,
+        start_new_session=True, cwd=HERE, env=env,
     )
     try:
         out, err = proc.communicate(timeout=timeout_s)
@@ -368,7 +396,9 @@ def main() -> None:
             notes.append(f"no budget for {','.join(group)}")
             return None
         done_before = _n_done()
-        err = _run_child(["--serve", ",".join(group), str(hard - 45)], hard)[1]
+        err = _run_child(
+            ["--serve", ",".join(group), str(hard - 45)], hard,
+            cpu_only=all(s.split(":")[2] == "cpu" for s in group))[1]
         if err and _n_done() > done_before:
             # the JSONL "done" record is authoritative: the child finished
             # every spec and exited its measurement loop; a truncated stdout
@@ -437,8 +467,8 @@ def main() -> None:
                 sess = [
                     {k: rec[k] for k in (
                         "spec", "backend", "dtype", "noise_mode", "device",
-                        "step_ms", "peak_hbm_gb", "nodes_per_sec_per_chip",
-                        "compile_s") if k in rec}
+                        "step_ms", "peak_hbm_gb", "xla_temp_gb", "xla_arg_gb",
+                        "nodes_per_sec_per_chip", "compile_s") if k in rec}
                     for rec in _read_results(cand)[0]
                     if rec.get("device") != "cpu"
                 ]
@@ -461,7 +491,7 @@ def main() -> None:
         degraded = True
         _, err = _run_child(
             ["--serve", "xla:float32:cpu:8:3", str(_remaining() - 50)],
-            _remaining() - 20)
+            _remaining() - 20, cpu_only=True)
         if err:
             notes.append(f"cpu fallback failed ({err})")
         results, _ = _read_results()
@@ -516,7 +546,8 @@ def main() -> None:
             out["notes"] = "; ".join(notes)
         def _variant_rec(r: dict) -> dict:
             rec = {k: r[k] for k in ("backend", "dtype", "device", "step_ms",
-                                     "peak_hbm_gb", "nodes_per_sec_per_chip")
+                                     "peak_hbm_gb", "xla_temp_gb",
+                                     "nodes_per_sec_per_chip")
                    if k in r}
             # self-describing artifact (r4 verdict weak #6): pallas on CPU is
             # pl.pallas_call(interpret=True) — a correctness canary, not a

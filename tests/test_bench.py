@@ -50,7 +50,7 @@ def test_alive_tpu_best_variant_wins(bench, monkeypatch, capsys):
     """Probe alive → device specs served in one group; best nodes/s wins."""
     calls = []
 
-    def fake_child(args, timeout_s):
+    def fake_child(args, timeout_s, cpu_only=False):
         calls.append(args)
         if args[0] == "--probe":
             return {"ok": True, "platform": "tpu", "n_devices": 1}, None
@@ -71,7 +71,7 @@ def test_alive_tpu_best_variant_wins(bench, monkeypatch, capsys):
 
 
 def test_dead_probe_falls_back_to_cpu_specs(bench, monkeypatch, capsys):
-    def fake_child(args, timeout_s):
+    def fake_child(args, timeout_s, cpu_only=False):
         if args[0] == "--probe":
             return None, "timeout after 120s"
         specs = args[1].split(",")
@@ -95,7 +95,7 @@ def test_killed_serve_retries_untried_first(bench, monkeypatch, capsys):
     pre-kill and retry measurements."""
     state = {"round": 0}
 
-    def fake_child(args, timeout_s):
+    def fake_child(args, timeout_s, cpu_only=False):
         if args[0] == "--probe":
             return {"ok": True, "platform": "tpu", "n_devices": 1}, None
         state["round"] += 1
@@ -125,7 +125,7 @@ def test_killed_serve_retries_untried_first(bench, monkeypatch, capsys):
 def test_deterministic_error_not_retried(bench, monkeypatch, capsys):
     state = {"serves": 0}
 
-    def fake_child(args, timeout_s):
+    def fake_child(args, timeout_s, cpu_only=False):
         if args[0] == "--probe":
             return {"ok": True, "platform": "tpu", "n_devices": 1}, None
         state["serves"] += 1
@@ -149,7 +149,7 @@ def test_deterministic_error_not_retried(bench, monkeypatch, capsys):
 def test_malformed_bench_variants_flagged(bench, monkeypatch, capsys):
     monkeypatch.setenv("BENCH_VARIANTS", "xla:float32:cpu,xla:float32:cpu:8:3")
 
-    def fake_child(args, timeout_s):
+    def fake_child(args, timeout_s, cpu_only=False):
         if args[0] == "--probe":
             return None, "timeout after 120s"
         for spec in args[1].split(","):
@@ -171,7 +171,7 @@ def test_done_record_authoritative_over_stdout_marker(bench, monkeypatch, capsys
     no retry round (ADVICE r3)."""
     state = {"serves": 0}
 
-    def fake_child(args, timeout_s):
+    def fake_child(args, timeout_s, cpu_only=False):
         if args[0] == "--probe":
             return {"ok": True, "platform": "tpu", "n_devices": 1}, None
         state["serves"] += 1
@@ -205,7 +205,7 @@ def test_dead_probe_embeds_archived_tpu_session(bench, monkeypatch, tmp_path, ca
         + json.dumps(dict(_result("xla:float32:cpu:6:4", 10.0), device="cpu"))
         + "\n")
 
-    def fake_child(args, timeout_s):
+    def fake_child(args, timeout_s, cpu_only=False):
         if args[0] == "--probe":
             return None, "timeout after 120s"
         for spec in args[1].split(","):
@@ -238,7 +238,7 @@ def test_empty_newer_archive_falls_back_to_older(bench, monkeypatch, tmp_path, c
         + "\n" + json.dumps({"phase": "error", "spec": "x", "error": "died"})
         + "\n")
 
-    def fake_child(args, timeout_s):
+    def fake_child(args, timeout_s, cpu_only=False):
         if args[0] == "--probe":
             return None, "timeout after 120s"
         for spec in args[1].split(","):
@@ -259,7 +259,7 @@ def test_live_device_result_omits_tpu_session(bench, monkeypatch, tmp_path, caps
     (perf / "bench_results_tpu_20260731T000000Z.jsonl").write_text(
         json.dumps(_result("pallas:float32:default:64:20", 700.0)) + "\n")
 
-    def fake_child(args, timeout_s):
+    def fake_child(args, timeout_s, cpu_only=False):
         if args[0] == "--probe":
             return {"ok": True, "platform": "tpu", "n_devices": 1}, None
         for spec in args[1].split(","):
@@ -278,7 +278,7 @@ def test_vs_baseline_ratio(bench, monkeypatch, tmp_path, capsys):
     with open(tmp_path / "baseline_torch.json", "w") as f:
         json.dump({"ast_nodes_per_sec_per_chip": 100.0, "device": "cpu"}, f)
 
-    def fake_child(args, timeout_s):
+    def fake_child(args, timeout_s, cpu_only=False):
         if args[0] == "--probe":
             return {"ok": True, "platform": "tpu", "n_devices": 1}, None
         for spec in args[1].split(","):
@@ -300,7 +300,7 @@ def test_cpu_ratio_uses_same_batch_baseline(bench, monkeypatch, tmp_path, capsys
         json.dump({"ast_nodes_per_sec_per_chip": 306.1, "device": "cpu",
                    "batch": 6, "by_batch": {"6": 306.1, "64": 252.6}}, f)
 
-    def fake_child(args, timeout_s):
+    def fake_child(args, timeout_s, cpu_only=False):
         if args[0] == "--probe":
             return None, "timeout after 120s"
         for spec in args[1].split(","):

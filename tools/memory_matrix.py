@@ -32,6 +32,7 @@ import time
 
 HERE = os.path.dirname(os.path.abspath(__file__))
 REPO = os.path.dirname(HERE)
+sys.path.insert(0, REPO)  # tools.xla_util import when run as a script
 
 
 def combos(device: str):
@@ -77,12 +78,18 @@ def main() -> None:
                "--steps", combo["steps"], "--max_src_len", combo["max_src_len"],
                "--remat", combo["remat"], "--backend", combo["backend"],
                "--noise_mode", combo["noise_mode"]]
+        env = None
         if args.device == "cpu":
             cmd += ["--platform", "cpu"]
+            # CPU combos must not touch the axon PJRT plugin (see
+            # tools/xla_util.cpu_child_env for the wedge this avoids)
+            from tools.xla_util import cpu_child_env
+
+            env = cpu_child_env()
         t0 = time.time()
         try:
             proc = subprocess.run(cmd, capture_output=True, text=True,
-                                  timeout=args.timeout, cwd=REPO)
+                                  timeout=args.timeout, cwd=REPO, env=env)
         except subprocess.TimeoutExpired:
             rec = {"combo": combo, "error": f"timeout {args.timeout}s"}
             rows.append(rec)

@@ -1,0 +1,42 @@
+#!/bin/bash
+# Round-5 sequential job chain for the single CPU core: wait for the
+# frozen-PAD 24-epoch A/B (pid $1), then run the bf16 12-epoch rerun
+# (checkpoints kept, pairs with the existing f32 pad_row=zero rows), then
+# the CPU memory matrix with the new XLA static-memory analysis.
+set -u
+cd "$(dirname "$0")/.."
+AB_PID=${1:?pid of the frozen A/B run}
+LOG=results/r5_chain.log
+say() { echo "[$(date -u +%T)] $*" >> "$LOG"; }
+
+say "chain armed behind pid $AB_PID"
+while kill -0 "$AB_PID" 2>/dev/null; do sleep 60; done
+say "A/B finished; launching bf16 12-epoch rerun"
+
+# every child is CPU-only: scrub the axon plugin env so a half-dead relay
+# cannot hang interpreter startup (tools/xla_util.cpu_child_env rationale)
+CPUENV="env PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu"
+
+# 4 heads + seed 2021 reproduces r4's sbm_bf16 run (3.47 test BLEU)
+# deterministically — this time KEEPING its epoch checkpoints, so the same
+# weights can be decoded under both dtypes (train-vs-decode attribution)
+$CPUENV python tools/train_real.py --data_dir ./data/stdlib_python \
+  --variant sbm --epochs 12 --compute_dtype bfloat16 --tag bf16r5 \
+  --out ./outputs/r5bf16 >> results/real_stdlib/train_bf16_r5.log 2>&1
+say "bf16 rerun rc=$?; launching f32-decode rescore of its checkpoints"
+
+$CPUENV python tools/reeval_ckpt.py \
+  --run_dir outputs/r5bf16/final_exp/real_stdlib_sbm_bf16r5 \
+  --split test --compute_dtype float32 \
+  >> results/real_stdlib/train_bf16_r5.log 2>&1
+say "f32 rescore rc=$?; launching bf16-decode rescore (same ckpts, control)"
+
+$CPUENV python tools/reeval_ckpt.py \
+  --run_dir outputs/r5bf16/final_exp/real_stdlib_sbm_bf16r5 \
+  --split test \
+  >> results/real_stdlib/train_bf16_r5.log 2>&1
+say "bf16 rescore rc=$?; launching CPU memory matrix"
+
+$CPUENV python tools/memory_matrix.py --device cpu \
+  --out results/perf/memory_matrix_cpu_r5.jsonl >> "$LOG" 2>&1
+say "memory matrix rc=$?; chain done"

@@ -32,6 +32,11 @@ def main() -> None:
     p.add_argument("--epochs", type=int, nargs="*", default=[],
                    help="checkpoint epochs to score (default: all on disk)")
     p.add_argument("--out", default="", help="default: <run_dir>/reeval_<split>.json")
+    p.add_argument("--compute_dtype", default="",
+                   choices=["", "float32", "bfloat16"],
+                   help="decode-time activation dtype override — decouples "
+                        "training dtype from eval dtype (params are always "
+                        "f32), for the bf16 train-vs-decode attribution")
     args = p.parse_args()
 
     os.environ.setdefault("JAX_PLATFORMS", "cpu")
@@ -68,6 +73,8 @@ def main() -> None:
         dims["num_heads"] = run_args["num_heads"]
     if run_args.get("compute_dtype"):
         dims["compute_dtype"] = run_args["compute_dtype"]
+    if args.compute_dtype:
+        dims["compute_dtype"] = args.compute_dtype
     if run_args.get("floor"):
         dims["sbm_floor"] = float(run_args["floor"])
     if run_args.get("seed"):
@@ -110,9 +117,14 @@ def main() -> None:
         results.append(rec)
         print(json.dumps(rec), flush=True)
 
-    out = args.out or os.path.join(args.run_dir, f"reeval_{args.split}.json")
+    suffix = f"_{args.compute_dtype}" if args.compute_dtype else ""
+    out = args.out or os.path.join(
+        args.run_dir, f"reeval_{args.split}{suffix}.json")
     with open(out, "w") as f:
         json.dump({"run_dir": args.run_dir, "metric": "corpus_bleu_x100",
+                   "eval_compute_dtype": cfg.compute_dtype,
+                   "train_compute_dtype": run_args.get("compute_dtype") or
+                   "float32",
                    "results": results}, f, indent=1)
 
 

@@ -38,6 +38,9 @@ def peak_bytes() -> int:
         return 0
 
 
+from tools.xla_util import xla_mem  # noqa: E402  (shared with bench.py)
+
+
 def live_bytes() -> int:
     """Sum of currently-live device buffers — a best-effort floor for CPU,
     where the backend exposes no ``memory_stats()``. Captures residents
@@ -127,12 +130,17 @@ def main() -> None:
     key = jax.random.key(0)
 
     # --- forward-only sweep (ref :103-125) ---
-    jax.block_until_ready(fwd(state.params, batches[0], key))  # compile
+    # AOT-compile once: the SAME executable serves the sweep and the static
+    # memory analysis (a separate .lower().compile() would double compile
+    # cost at N=512 and could analyze a different schedule)
+    fwd_c = fwd.lower(state.params, batches[0], key).compile()
+    fwd_mem = xla_mem(fwd_c)
+    jax.block_until_ready(fwd_c(state.params, batches[0], key))  # warmup
     fwd_times = []
     for _ in range(args.reps):
         t0 = time.perf_counter()
         for b in batches:
-            out = fwd(state.params, b, key)
+            out = fwd_c(state.params, b, key)
         jax.block_until_ready(out)
         fwd_times.append(time.perf_counter() - t0)
     fwd_peak = peak_bytes()
@@ -140,13 +148,15 @@ def main() -> None:
     fwd_rss = host_rss_peak_bytes()
 
     # --- forward+backward sweep (ref :129-149) ---
-    state, m = step(state, batches[0])  # compile
+    step_c = step.lower(state, batches[0]).compile()
+    fb_mem = xla_mem(step_c)
+    state, m = step_c(state, batches[0])  # warmup
     jax.block_until_ready(m["loss"])
     fb_times = []
     for _ in range(args.reps):
         t0 = time.perf_counter()
         for b in batches:
-            state, m = step(state, b)
+            state, m = step_c(state, b)
         jax.block_until_ready(m["loss"])
         fb_times.append(time.perf_counter() - t0)
     fb_peak = peak_bytes()
@@ -169,11 +179,13 @@ def main() -> None:
         "fwd_peak_gb": round(fwd_peak / 2**30, 3),
         "fwd_live_gb": round(fwd_live / 2**30, 3),
         "fwd_host_rss_peak_gb": round(fwd_rss / 2**30, 3),
+        "fwd_xla": fwd_mem,
         "fwdbwd_sec_mean": round(sum(fb_times) / len(fb_times), 4),
         "fwdbwd_sec_min": round(min(fb_times), 4),
         "fwdbwd_peak_gb": round(fb_peak / 2**30, 3),
         "fwdbwd_live_gb": round(fb_live / 2**30, 3),
         "fwdbwd_host_rss_peak_gb": round(fb_rss / 2**30, 3),
+        "fwdbwd_xla": fb_mem,
         "fwd_nodes_per_sec": round(nodes / min(fwd_times), 1),
         "fwdbwd_nodes_per_sec": round(nodes / min(fb_times), 1),
     }

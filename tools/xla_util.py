@@ -1,0 +1,48 @@
+"""Shared jax-free helpers for the perf tooling.
+
+Kept free of ``import jax`` on purpose: the bench parent and the matrix
+driver import from here without paying backend-plugin costs — only child
+processes touch jax.
+"""
+
+from __future__ import annotations
+
+import os
+
+__all__ = ["cpu_child_env", "xla_mem"]
+
+
+def cpu_child_env() -> dict:
+    """Env for CPU-only child interpreters: skips the axon PJRT plugin
+    entirely. The baked sitecustomize registers the plugin in EVERY python
+    process (gated on ``PALLAS_AXON_POOL_IPS`` truthiness), and when the
+    relay is half-dead its retry loop hangs interpreter startup for minutes
+    (observed r5) — this is the single shared off-switch recipe."""
+    return dict(os.environ, PALLAS_AXON_POOL_IPS="", JAX_PLATFORMS="cpu")
+
+
+def xla_mem(compiled) -> dict:
+    """XLA's compiled-program memory analysis — the static allocation plan
+    (argument/output/temp/alias bytes) that decides HBM fit at compile time
+    on TPU. Unlike runtime ``memory_stats()`` this works on every backend,
+    so the CPU matrix gets real peak numbers too: ``static_peak_gb`` =
+    arguments + outputs + temps − aliased (donation), and ``xla_temp_gb``
+    alone isolates the transient intermediates that remat and the flash
+    kernel exist to remove (the (B,H,N,N) tensors)."""
+    try:
+        ma = compiled.memory_analysis()
+        if ma is None:
+            return {}
+        arg = int(ma.argument_size_in_bytes)
+        out = int(ma.output_size_in_bytes)
+        tmp = int(ma.temp_size_in_bytes)
+        ali = int(ma.alias_size_in_bytes)
+        return {
+            "xla_arg_gb": round(arg / 2**30, 3),
+            "xla_out_gb": round(out / 2**30, 3),
+            "xla_temp_gb": round(tmp / 2**30, 3),
+            "xla_alias_gb": round(ali / 2**30, 3),
+            "static_peak_gb": round((arg + out + tmp - ali) / 2**30, 3),
+        }
+    except Exception as e:  # noqa: BLE001 — best-effort telemetry
+        return {"xla_mem_error": str(e)[:160]}
