@@ -285,9 +285,21 @@ class Trainer:
         self.train_step = make_train_step(self.model, self.tx, cfg)
         self.decode_fn = _decode_fn(self.model)
         self.output_dir = os.path.join(cfg.output_dir, cfg.project_name, cfg.task_name)
+        # optional externally-supplied initial params (same tree structure
+        # as the model's own init) — e.g. a ported torch-reference init for
+        # init-parity A/Bs (tools/torch_init.py). Optimizer moments start
+        # at zero either way.
+        self.initial_params = None
 
     def init_state(self, example: Batch) -> TrainState:
         state = create_train_state(self.model, self.tx, example, self.cfg.seed)
+        if self.initial_params is not None:
+            import chex
+
+            chex.assert_trees_all_equal_shapes(
+                state.params, self.initial_params)
+            state = state.replace(
+                params=jax.tree.map(jnp.asarray, self.initial_params))
         n_params = sum(int(np.prod(p.shape)) for p in jax.tree.leaves(state.params))
         self.log(f"num_param: {n_params}")
         return state

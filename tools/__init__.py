@@ -1,7 +1,7 @@
 """Operational tooling (benchmarks, corpus builders, diagnostics).
 
-A package so shared helpers (``tools.time_memory.xla_mem``,
-``tools.time_memory.cpu_child_env``) are importable from ``bench.py`` and
-between tools — every module here also still runs standalone via
-``python tools/<name>.py``.
+A package so shared helpers (``tools.xla_util.xla_mem``,
+``tools.xla_util.cpu_child_env`` — jax-free on purpose) are importable
+from ``bench.py`` and between tools — every module here also still runs
+standalone via ``python tools/<name>.py``.
 """

@@ -9,6 +9,15 @@ AB_PID=${1:?pid of the frozen A/B run}
 LOG=results/r5_chain.log
 say() { echo "[$(date -u +%T)] $*" >> "$LOG"; }
 
+# single-instance lock: a double launch would run the identical bf16 rerun
+# twice into the same output dir, interleaving checkpoint writes
+LOCK=/tmp/r5_chain.pid
+if [ -f "$LOCK" ] && kill -0 "$(cat "$LOCK")" 2>/dev/null; then
+  say "another chain instance ($(cat "$LOCK")) is live — exiting"
+  exit 1
+fi
+echo $$ > "$LOCK"
+
 say "chain armed behind pid $AB_PID"
 while kill -0 "$AB_PID" 2>/dev/null; do sleep 60; done
 say "A/B finished; launching bf16 12-epoch rerun"

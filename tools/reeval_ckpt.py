@@ -62,10 +62,11 @@ def main() -> None:
     # rebuild the cfg exactly as tools/train_real.py did for this run
     name = run_args.get("config") or (
         "python_full_att" if run_args["variant"] == "full_att" else "python")
+    w = run_args.get("width") or 128  # train_real.py's --width dims rule
     dims = {} if run_args.get("full_dims") else dict(
-        pe_dim=64, pegen_dim=128, sbm_enc_dim=128, hidden_size=128,
+        pe_dim=w // 2, pegen_dim=w, sbm_enc_dim=w, hidden_size=w,
         num_heads=4, num_layers=2, sbm_layers=2, clusters=(8, 8),
-        dim_feed_forward=512, max_tgt_len=30,
+        dim_feed_forward=4 * w, max_tgt_len=30,
     )
     if run_args.get("backend"):
         dims["backend"] = run_args["backend"]

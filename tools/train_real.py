@@ -68,6 +68,20 @@ def main() -> None:
     p.add_argument("--pad_row", default="", choices=["", "zero", "frozen"],
                    help="PAD-embedding-row mode (configs.Config.pad_row; "
                         "'frozen' = reference-parity garbage row)")
+    p.add_argument("--width", type=int, default=0,
+                   help="CPU-budget model width override (sbm_enc/hidden/"
+                        "pegen = width, pe = width//2, ff = 4*width) — 64 "
+                        "pairs with tools/train_torch_real.py --width 64 "
+                        "on the scaled corpus")
+    p.add_argument("--init_from_torch", action="store_true",
+                   help="initialize from an ACTUAL torch-reference init at "
+                        "cfg.seed (ported via the parity-test converters): "
+                        "removes every init-distribution difference at once "
+                        "— torch's packed in_proj xavier fan (sqrt2 smaller "
+                        "than per-matrix xavier on decoder q/k/v) and its "
+                        "nonzero uniform Linear-bias init (VERDICT r4 "
+                        "#2(b)). Requires num_heads=8 (reference CSE "
+                        "hard-tiles 4+4).")
     args = p.parse_args()
 
     os.environ["JAX_PLATFORMS"] = args.platform
@@ -85,16 +99,17 @@ def main() -> None:
 
     name = args.config or (
         "python_full_att" if args.variant == "full_att" else "python")
+    w = args.width or 128
     dims = {} if args.full_dims else dict(
-        pe_dim=64,
-        pegen_dim=128,
-        sbm_enc_dim=128,
-        hidden_size=128,
+        pe_dim=w // 2,
+        pegen_dim=w,
+        sbm_enc_dim=w,
+        hidden_size=w,
         num_heads=4,
         num_layers=2,
         sbm_layers=2,
         clusters=(8, 8),
-        dim_feed_forward=512,
+        dim_feed_forward=4 * w,
         max_tgt_len=30,
     )
     if args.backend:
@@ -141,6 +156,12 @@ def main() -> None:
         log_f.flush()
 
     trainer = Trainer(cfg, log=log)
+    if args.init_from_torch:
+        from tools.torch_init import torch_reference_init
+
+        trainer.initial_params = torch_reference_init(
+            cfg, trainer.src_vocab.size(), trainer.tgt_vocab.size())
+        log("initialized from ported torch-reference init (tools/torch_init)")
     train_ds = ASTDataset(cfg, "train", trainer.src_vocab, trainer.tgt_vocab)
     val_ds = ASTDataset(cfg, "dev", trainer.src_vocab, trainer.tgt_vocab)
     test_ds = ASTDataset(cfg, "test", trainer.src_vocab, trainer.tgt_vocab)

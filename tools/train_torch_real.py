@@ -106,6 +106,11 @@ def main() -> None:
     p.add_argument("--val_interval", type=int, default=4)
     p.add_argument("--out", default="./results/real_stdlib_torch")
     p.add_argument("--threads", type=int, default=0)
+    p.add_argument("--width", type=int, default=128,
+                   help="model width (sbm_enc/hidden/pegen; pe=width//2, "
+                        "ff=4*width) — 64 is the scaled-corpus CPU budget")
+    p.add_argument("--seed", type=int, default=0,
+                   help="override cfg.seed (0 = config default 2021)")
     args = p.parse_args()
 
     import numpy as np
@@ -127,11 +132,13 @@ def main() -> None:
     from csat_tpu.metrics import bleu_output_transform, eval_accuracies
 
     # train_real.py CPU dims, at the reference's mandatory 8 heads
+    w = args.width
+    over = {"seed": args.seed} if args.seed else {}
     cfg = get_config(
         "python", data_dir=args.data_dir, batch_size=args.batch_size,
-        pe_dim=64, pegen_dim=128, sbm_enc_dim=128, hidden_size=128,
+        pe_dim=w // 2, pegen_dim=w, sbm_enc_dim=w, hidden_size=w,
         num_heads=8, num_layers=2, sbm_layers=2, clusters=(8, 8),
-        dim_feed_forward=512, max_tgt_len=30,
+        dim_feed_forward=4 * w, max_tgt_len=30, **over,
     )
     src_vocab, tgt_vocab = load_vocab(cfg.data_dir)
     train_ds = ASTDataset(cfg, "train", src_vocab, tgt_vocab)
