@@ -31,6 +31,29 @@ def test_train_smoke(synthetic_corpus, tiny_config):
     assert out.shape == (8, cfg.max_tgt_len - 1)
 
 
+def test_initial_params_injection(synthetic_corpus, tiny_config):
+    """``Trainer.initial_params`` (the init-parity lever,
+    ``tools/torch_init.py``) replaces the flax init verbatim while keeping
+    zero optimizer moments; a wrong-shaped tree is rejected up front."""
+    cfg = tiny_config.replace(
+        data_dir=synthetic_corpus, full_att=True, num_epochs=1,
+        dropout=0.0, attention_dropout=0.0,
+    )
+    trainer = Trainer(cfg, log=lambda s: None)
+    train_ds = ASTDataset(cfg, "train", trainer.src_vocab, trainer.tgt_vocab)
+    example = next(iterate_batches(train_ds, cfg.batch_size, shuffle=False))
+    base = trainer.init_state(example)
+    marked = jax.tree.map(lambda p: np.full_like(np.asarray(p), 0.125), base.params)
+    trainer.initial_params = marked
+    state = trainer.init_state(example)
+    assert float(np.asarray(jax.tree.leaves(state.params)[0]).ravel()[0]) == 0.125
+    # wrong shapes must fail loudly, not train silently mis-assembled
+    trainer.initial_params = jax.tree.map(
+        lambda p: np.zeros(np.asarray(p).shape + (1,), np.float32), base.params)
+    with pytest.raises(AssertionError):
+        trainer.init_state(example)
+
+
 @pytest.fixture(scope="module")
 def trained(synthetic_corpus, tiny_config):
     """Train the CPU-smoke config (full attention, ref python_full_att) to

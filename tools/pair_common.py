@@ -1,0 +1,101 @@
+"""Shared plumbing for the paired JAX-vs-torch-reference experiments.
+
+Single source of truth for the three things the pairing tools kept
+restating independently (r5 review): the reference-import stubs, the
+CPU-budget width→dims rule, and the reference-model constructor call.
+"""
+
+from __future__ import annotations
+
+import importlib.util
+import os
+import sys
+import types
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+REF = "/root/reference"
+
+__all__ = ["import_reference", "cpu_dims", "build_reference_model"]
+
+
+def import_reference():
+    """Import the reference model package with the dependency stubs the
+    parity tests use (torch_geometric / ipdb / old-torch typing shims).
+    → (module, utils, optimizer-module)."""
+    import typing
+
+    import torch.utils.data.dataset as tud
+
+    if "torch_geometric" not in sys.modules:
+        tg = types.ModuleType("torch_geometric")
+        tgd = types.ModuleType("torch_geometric.data")
+
+        class Data:
+            def __init__(self, **kw):
+                self.__dict__.update(kw)
+
+        tgd.Data = Data
+        tg.data = tgd
+        sys.modules["torch_geometric"] = tg
+        sys.modules["torch_geometric.data"] = tgd
+    sys.modules.setdefault("ipdb", types.ModuleType("ipdb"))
+    if not hasattr(tud, "T_co"):
+        tud.T_co = typing.TypeVar("T_co", covariant=True)
+    if REF not in sys.path:
+        sys.path.insert(0, REF)
+    import module as ref_module
+    import utils as ref_utils
+
+    # script/__init__ pulls in ignite; load the optimizer file directly
+    spec = importlib.util.spec_from_file_location(
+        "ref_optimizer", os.path.join(REF, "script", "optimizer.py"))
+    ref_optimizer = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(ref_optimizer)
+    return ref_module, ref_utils, ref_optimizer
+
+
+def cpu_dims(width: int = 128, sequential: bool = False) -> dict:
+    """The CPU-budget pairing dims at ``width`` (the rule every pairing
+    tool must share): sbm_enc/hidden/pegen = w, pe = w//2, ff = 4w,
+    2+2 layers, clusters (8,8), max_tgt_len 30. ``sequential`` drops the
+    pegen-stack dims (seq-PE configs set pe_dim=0; sizing them would
+    violate ``Config.validate``)."""
+    w = width
+    dims = dict(
+        pe_dim=w // 2,
+        pegen_dim=w,
+        sbm_enc_dim=w,
+        hidden_size=w,
+        num_heads=4,
+        num_layers=2,
+        sbm_layers=2,
+        clusters=(8, 8),
+        dim_feed_forward=4 * w,
+        max_tgt_len=30,
+    )
+    if sequential:
+        dims.pop("pe_dim")
+        dims.pop("pegen_dim")
+    return dims
+
+
+def build_reference_model(ref_module, cfg, src_vocab_size: int,
+                          tgt_vocab_size: int):
+    """Construct the reference ``CSATrans`` from a csat-tpu ``Config`` —
+    the ONE ctor call both the torch baseline trainer and the init porter
+    use, so seed-for-seed init pairing cannot drift between call sites.
+    Seeds torch with ``cfg.seed`` immediately before construction."""
+    import torch
+
+    torch.manual_seed(cfg.seed)
+    return ref_module.csa_trans.CSATrans(
+        src_vocab_size=src_vocab_size, tgt_vocab_size=tgt_vocab_size,
+        hidden_size=cfg.hidden_size, num_heads=cfg.num_heads,
+        num_layers=cfg.num_layers, sbm_layers=cfg.sbm_layers,
+        use_pegen=cfg.use_pegen, dim_feed_forward=cfg.dim_feed_forward,
+        dropout=cfg.dropout, pe_dim=cfg.pe_dim, pegen_dim=cfg.pegen_dim,
+        sbm_enc_dim=cfg.sbm_enc_dim, clusters=list(cfg.clusters),
+        full_att=cfg.full_att, max_src_len=cfg.max_src_len,
+    )

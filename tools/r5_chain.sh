@@ -17,6 +17,7 @@ if [ -f "$LOCK" ] && kill -0 "$(cat "$LOCK")" 2>/dev/null; then
   exit 1
 fi
 echo $$ > "$LOCK"
+trap 'rm -f "$LOCK"' EXIT
 
 say "chain armed behind pid $AB_PID"
 while kill -0 "$AB_PID" 2>/dev/null; do sleep 60; done

@@ -60,14 +60,15 @@ def main() -> None:
     from csat_tpu.metrics import bleu_output_transform, eval_accuracies
 
     # rebuild the cfg exactly as tools/train_real.py did for this run
+    from tools.pair_common import cpu_dims
+
     name = run_args.get("config") or (
         "python_full_att" if run_args["variant"] == "full_att" else "python")
-    w = run_args.get("width") or 128  # train_real.py's --width dims rule
-    dims = {} if run_args.get("full_dims") else dict(
-        pe_dim=w // 2, pegen_dim=w, sbm_enc_dim=w, hidden_size=w,
-        num_heads=4, num_layers=2, sbm_layers=2, clusters=(8, 8),
-        dim_feed_forward=4 * w, max_tgt_len=30,
-    )
+    sequential = False
+    if run_args.get("config"):
+        sequential = get_config(run_args["config"]).pe_dim == 0
+    dims = {} if run_args.get("full_dims") else cpu_dims(
+        run_args.get("width") or 128, sequential=sequential)
     if run_args.get("backend"):
         dims["backend"] = run_args["backend"]
     if run_args.get("num_heads"):

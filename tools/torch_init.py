@@ -39,26 +39,16 @@ def torch_reference_init(cfg, src_vocab_size: int, tgt_vocab_size: int):
     assert cfg.num_heads == 8, (
         "the reference CSE hard-tiles 4 L-heads + 4 T-heads "
         "(csa_trans.py:206-211); init porting requires num_heads=8")
-    import torch
+    from tools.pair_common import build_reference_model, import_reference
 
-    from tools.train_torch_real import _import_reference
-
-    ref_module, _, _ = _import_reference()
+    ref_module, _, _ = import_reference()
     spec = importlib.util.spec_from_file_location(
         "parity_helpers", os.path.join(REPO, "tests", "test_reference_parity.py"))
     ph = importlib.util.module_from_spec(spec)
     spec.loader.exec_module(ph)
 
-    torch.manual_seed(cfg.seed)
-    tmodel = ref_module.csa_trans.CSATrans(
-        src_vocab_size=src_vocab_size, tgt_vocab_size=tgt_vocab_size,
-        hidden_size=cfg.hidden_size, num_heads=cfg.num_heads,
-        num_layers=cfg.num_layers, sbm_layers=cfg.sbm_layers,
-        use_pegen="pegen", dim_feed_forward=cfg.dim_feed_forward,
-        dropout=cfg.dropout, pe_dim=cfg.pe_dim, pegen_dim=cfg.pegen_dim,
-        sbm_enc_dim=cfg.sbm_enc_dim, clusters=list(cfg.clusters),
-        full_att=cfg.full_att, max_src_len=cfg.max_src_len,
-    )
+    tmodel = build_reference_model(
+        ref_module, cfg, src_vocab_size, tgt_vocab_size)
     sd = tmodel.state_dict()
     params = {
         "src_embedding": ph._emb(sd, "src_embedding"),

@@ -99,19 +99,9 @@ def main() -> None:
 
     name = args.config or (
         "python_full_att" if args.variant == "full_att" else "python")
-    w = args.width or 128
-    dims = {} if args.full_dims else dict(
-        pe_dim=w // 2,
-        pegen_dim=w,
-        sbm_enc_dim=w,
-        hidden_size=w,
-        num_heads=4,
-        num_layers=2,
-        sbm_layers=2,
-        clusters=(8, 8),
-        dim_feed_forward=4 * w,
-        max_tgt_len=30,
-    )
+    from tools.pair_common import cpu_dims
+
+    dims = {} if args.full_dims else cpu_dims(args.width or 128)
     if args.backend:
         dims["backend"] = args.backend
     if args.num_heads:

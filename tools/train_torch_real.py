@@ -45,38 +45,11 @@ REF = "/root/reference"
 
 
 def _import_reference():
-    """Import the reference model package with the same dependency stubs the
-    parity tests use (torch_geometric / ipdb / old-torch typing shims)."""
-    import typing
+    """Back-compat alias: the stub-importer now lives in tools.pair_common
+    (shared by lockstep_ab / step0_probe / torch_init)."""
+    from tools.pair_common import import_reference
 
-    import torch.utils.data.dataset as tud
-
-    if "torch_geometric" not in sys.modules:
-        tg = types.ModuleType("torch_geometric")
-        tgd = types.ModuleType("torch_geometric.data")
-
-        class Data:
-            def __init__(self, **kw):
-                self.__dict__.update(kw)
-
-        tgd.Data = Data
-        tg.data = tgd
-        sys.modules["torch_geometric"] = tg
-        sys.modules["torch_geometric.data"] = tgd
-    sys.modules.setdefault("ipdb", types.ModuleType("ipdb"))
-    if not hasattr(tud, "T_co"):
-        tud.T_co = typing.TypeVar("T_co", covariant=True)
-    if REF not in sys.path:
-        sys.path.insert(0, REF)
-    import module as ref_module
-    import utils as ref_utils
-
-    # script/__init__ pulls in ignite; load the optimizer file directly
-    spec = importlib.util.spec_from_file_location(
-        "ref_optimizer", os.path.join(REF, "script", "optimizer.py"))
-    ref_optimizer = importlib.util.module_from_spec(spec)
-    spec.loader.exec_module(ref_optimizer)
-    return ref_module, ref_utils, ref_optimizer
+    return import_reference()
 
 
 def _to_torch(batch, torch):
@@ -132,29 +105,22 @@ def main() -> None:
     from csat_tpu.metrics import bleu_output_transform, eval_accuracies
 
     # train_real.py CPU dims, at the reference's mandatory 8 heads
-    w = args.width
+    from tools.pair_common import cpu_dims
+
     over = {"seed": args.seed} if args.seed else {}
     cfg = get_config(
         "python", data_dir=args.data_dir, batch_size=args.batch_size,
-        pe_dim=w // 2, pegen_dim=w, sbm_enc_dim=w, hidden_size=w,
-        num_heads=8, num_layers=2, sbm_layers=2, clusters=(8, 8),
-        dim_feed_forward=4 * w, max_tgt_len=30, **over,
+        **{**cpu_dims(args.width), "num_heads": 8}, **over,
     )
     src_vocab, tgt_vocab = load_vocab(cfg.data_dir)
     train_ds = ASTDataset(cfg, "train", src_vocab, tgt_vocab)
     dev_ds = ASTDataset(cfg, "dev", src_vocab, tgt_vocab)
     test_ds = ASTDataset(cfg, "test", src_vocab, tgt_vocab)
 
-    torch.manual_seed(cfg.seed)
-    model = ref_module.csa_trans.CSATrans(
-        src_vocab_size=src_vocab.size(), tgt_vocab_size=tgt_vocab.size(),
-        hidden_size=cfg.hidden_size, num_heads=cfg.num_heads,
-        num_layers=cfg.num_layers, sbm_layers=cfg.sbm_layers,
-        use_pegen="pegen", dim_feed_forward=cfg.dim_feed_forward,
-        dropout=cfg.dropout, pe_dim=cfg.pe_dim, pegen_dim=cfg.pegen_dim,
-        sbm_enc_dim=cfg.sbm_enc_dim, clusters=list(cfg.clusters),
-        full_att=False, max_src_len=cfg.max_src_len,
-    )
+    from tools.pair_common import build_reference_model
+
+    model = build_reference_model(
+        ref_module, cfg, src_vocab.size(), tgt_vocab.size())
     n_param = sum(t.numel() for t in model.parameters())
     optimizer = ref_optimizer.AdamW(
         model.parameters(), lr=args.learning_rate, correct_bias=False)
