@@ -133,6 +133,12 @@ class Config:
     #              gradient (training-dynamics parity mode).
     #   "zero"   — zero PAD lookups (the cleaner variant, r1-r4 behavior).
     pad_row: str = "zero"
+    # initialization scheme (csat_tpu/models/init.py):
+    #   "flax"      — per-module xavier (r1-r4 behavior).
+    #   "reference" — the reference's realized distributions: torch's
+    #                 packed in_proj xavier fan on decoder q/k/v (√2
+    #                 smaller) and U(±1/√fan_in) Linear biases.
+    init_scheme: str = "flax"
     # observability (cli --profile / scalars.jsonl stream; SURVEY §5)
     scalar_log: bool = False
     profile: bool = False
@@ -160,6 +166,7 @@ class Config:
         ), self.use_pegen
         assert self.backend in ("xla", "pallas"), self.backend
         assert self.pad_row in ("zero", "frozen"), self.pad_row
+        assert self.init_scheme in ("flax", "reference"), self.init_scheme
         assert self.noise_mode in ("shared", "counter"), self.noise_mode
         assert self.seq_impl in ("allgather", "ring"), self.seq_impl
         if (self.seq_impl == "ring" and self.noise_mode != "counter"

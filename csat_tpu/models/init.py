@@ -1,0 +1,85 @@
+"""Reference-matching initialization, natively in JAX.
+
+The reference's init is "xavier_uniform_ on every dim>1 tensor"
+(``csa_trans.py:166-168``) — but two torch packaging details make its
+realized distributions differ from flax's per-module xavier
+(VERDICT r4 #2(b), measured by ``tools/torch_init.py``):
+
+* torch ``nn.MultiheadAttention`` packs q/k/v into one (3d, d)
+  ``in_proj_weight``; xavier over THAT fan gives bound √(6/4d) — the
+  decoder attention projections start √2 smaller than flax's per-matrix
+  √(6/2d). (torch zeroes the packed bias and ``out_proj`` bias, matching
+  flax's zero default, and ``out_proj``'s (d, d) weight xaviers
+  identically — only q/k/v kernels differ.)
+* torch ``nn.Linear`` biases start at U(±1/√fan_in) and the global
+  xavier loop only touches dim>1 tensors, so every reference Linear bias
+  is nonzero at init — flax biases start at zero.
+
+``apply_reference_init`` transforms an already-initialized flax params
+tree to the reference's realized distributions: decoder q/k/v kernels are
+redrawn with the packed fan, and every non-attention Dense bias is
+redrawn U(±1/√fan_in). Everything else (embeddings, LayerNorms, CSE rel
+tables, SBM cluster orthogonal init, all other kernels) already matches
+distribution-for-distribution and keeps the flax draw.
+
+Enabled by ``Config.init_scheme = "reference"`` (default ``"flax"``).
+"""
+
+from __future__ import annotations
+
+import zlib
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["apply_reference_init"]
+
+# decoder attention projections whose kernels torch draws with the packed
+# (3d, d) fan; their biases stay zero (torch MHA zeroes in_proj_bias)
+_ATTN_LEAVES = ("self_attn", "cross_attn")
+_PACKED_KERNELS = ("q", "k", "v")
+
+
+def _path_names(path) -> list:
+    return [str(getattr(k, "key", k)) for k in path]
+
+
+def apply_reference_init(params: Any, seed: int) -> Any:
+    """Redraw the two torch-skewed families in ``params`` (see module
+    docstring); deterministic in ``seed`` and the tree paths."""
+    root = jax.random.key(seed)
+
+    def visit(path, leaf):
+        names = _path_names(path)
+        if names[-1] == "bias":
+            in_attn = any(a in names for a in _ATTN_LEAVES)
+            if in_attn:
+                return leaf  # torch MHA biases are zeroed — keep
+            # sibling kernel's fan_in = its first axis; the bias leaf alone
+            # doesn't carry it, so look it up from the tree
+            node = params
+            for n in names[:-1]:
+                node = node[n]
+            kernel = node.get("kernel")
+            if kernel is None:
+                return leaf  # LayerNorm bias etc. — keep zeros
+            fan_in = kernel.shape[0]
+            bound = 1.0 / jnp.sqrt(float(fan_in))
+            k = jax.random.fold_in(root, zlib.crc32("/".join(names).encode()))
+            return jax.random.uniform(
+                k, leaf.shape, jnp.float32, -bound, bound).astype(leaf.dtype)
+        if names[-1] == "kernel" and len(names) >= 3:
+            if names[-2] in _PACKED_KERNELS and any(
+                a in names for a in _ATTN_LEAVES
+            ):
+                d_in, d_out = leaf.shape
+                # packed fan: (fan_in, fan_out) = (d_in, 3·d_out)
+                bound = jnp.sqrt(6.0 / float(d_in + 3 * d_out))
+                k = jax.random.fold_in(root, zlib.crc32("/".join(names).encode()))
+                return jax.random.uniform(
+                    k, leaf.shape, jnp.float32, -bound, bound
+                ).astype(leaf.dtype)
+        return leaf
+
+    return jax.tree_util.tree_map_with_path(visit, params)

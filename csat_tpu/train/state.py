@@ -73,6 +73,12 @@ def create_train_state(
     rng, init_rng, sample_rng = jax.random.split(rng, 3)
     variables = model.init({"params": init_rng, "sample": sample_rng}, example_batch)
     params = variables["params"]
+    if model.cfg.init_scheme == "reference":
+        # redraw the torch-skewed families (packed-fan decoder q/k/v,
+        # nonzero Linear biases) to the reference's realized distributions
+        from csat_tpu.models.init import apply_reference_init
+
+        params = apply_reference_init(params, seed)
     return TrainState(
         step=jnp.zeros([], jnp.int32),
         params=params,

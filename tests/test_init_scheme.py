@@ -1,0 +1,73 @@
+"""init_scheme="reference" redraws exactly the torch-skewed families.
+
+Validates csat_tpu/models/init.py against the measured reference
+distributions (tools/torch_init.py): decoder q/k/v kernels get the packed
+(3d, d) xavier fan (√2 smaller bound), non-attention Dense biases become
+U(±1/√fan_in), and everything else keeps the flax draw bit-for-bit.
+"""
+
+import numpy as np
+
+from csat_tpu.configs import get_config
+from csat_tpu.data.toy import random_batch
+from csat_tpu.train.optimizer import adamw
+from csat_tpu.train.state import create_train_state, make_model
+
+SRC_V, TGT_V = 120, 90
+
+
+def _states():
+    base = get_config(
+        "python", pe_dim=16, pegen_dim=32, sbm_enc_dim=64, hidden_size=64,
+        num_heads=8, num_layers=1, sbm_layers=2, clusters=(4, 4),
+        dim_feed_forward=128, max_src_len=32, max_tgt_len=12, batch_size=2,
+    )
+    batch = random_batch(base, 2, SRC_V, TGT_V, seed=3)
+    tx = adamw(1e-4)
+    out = {}
+    for scheme in ("flax", "reference"):
+        cfg = base.replace(init_scheme=scheme)
+        model = make_model(cfg, SRC_V, TGT_V)
+        out[scheme] = create_train_state(model, tx, batch, seed=7).params
+    return out
+
+
+def test_reference_init_families():
+    p = _states()
+    d = 64
+    flax_q = np.asarray(p["flax"]["decoder"]["layer_0"]["self_attn"]["q"]["kernel"])
+    ref_q = np.asarray(p["reference"]["decoder"]["layer_0"]["self_attn"]["q"]["kernel"])
+    # packed fan bound √(6/(d+3d)) vs per-matrix √(6/2d): √2 ratio in max
+    assert abs(ref_q.max() - np.sqrt(6 / (4 * d))) < 0.01
+    assert abs(flax_q.max() - np.sqrt(6 / (2 * d))) < 0.02
+    assert np.std(ref_q) < np.std(flax_q) * 0.8
+
+    # decoder attention biases stay zero (torch MHA zeroes in_proj_bias)
+    ref_qb = np.asarray(p["reference"]["decoder"]["layer_0"]["self_attn"]["q"]["bias"])
+    assert np.abs(ref_qb).max() == 0.0
+
+    # non-attention Dense biases become U(±1/√fan_in)
+    gen_k = np.asarray(p["reference"]["generator"]["Dense_0"]["kernel"])
+    gen_b = np.asarray(p["reference"]["generator"]["Dense_0"]["bias"])
+    bound = 1 / np.sqrt(gen_k.shape[0])
+    assert 0 < np.abs(gen_b).max() <= bound
+    assert np.std(gen_b) > bound / 4  # uniform std = bound/√3 ≈ 0.577·bound
+
+    # LayerNorm params untouched (scale ones, bias zeros)
+    ln = p["reference"]["encoder"]["LayerNorm_0"]
+    assert np.all(np.asarray(ln["scale"]) == 1.0)
+    assert np.abs(np.asarray(ln["bias"])).max() == 0.0
+
+    # non-decoder kernels keep the flax draw bit-for-bit
+    same = np.asarray(p["flax"]["encoder"]["out"]["kernel"])
+    refk = np.asarray(p["reference"]["encoder"]["out"]["kernel"])
+    np.testing.assert_array_equal(same, refk)
+
+
+def test_reference_init_deterministic():
+    a = _states()["reference"]
+    b = _states()["reference"]
+    import jax
+
+    for x, y in zip(jax.tree.leaves(a), jax.tree.leaves(b)):
+        np.testing.assert_array_equal(np.asarray(x), np.asarray(y))

@@ -73,6 +73,10 @@ def main() -> None:
                         "pegen = width, pe = width//2, ff = 4*width) — 64 "
                         "pairs with tools/train_torch_real.py --width 64 "
                         "on the scaled corpus")
+    p.add_argument("--init_scheme", default="", choices=["", "flax", "reference"],
+                   help="native init distributions (configs.Config."
+                        "init_scheme; 'reference' = packed-fan decoder "
+                        "q/k/v + uniform Linear biases, no torch needed)")
     p.add_argument("--init_from_torch", action="store_true",
                    help="initialize from an ACTUAL torch-reference init at "
                         "cfg.seed (ported via the parity-test converters): "
@@ -121,6 +125,8 @@ def main() -> None:
         dims["seed"] = args.seed
     if args.pad_row:
         dims["pad_row"] = args.pad_row
+    if args.init_scheme:
+        dims["init_scheme"] = args.init_scheme
     tag = f"_{args.tag}" if args.tag else ""
     cfg = get_config(
         name,
