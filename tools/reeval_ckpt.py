@@ -37,6 +37,16 @@ def main() -> None:
                    help="decode-time activation dtype override — decouples "
                         "training dtype from eval dtype (params are always "
                         "f32), for the bf16 train-vs-decode attribution")
+    p.add_argument("--eval_graph", default="", choices=["", "sample", "expected"],
+                   help="SBM graph mode at decode (configs.Config."
+                        "eval_graph; 'expected' = deterministic eval)")
+    p.add_argument("--eval_seeds", type=int, nargs="*", default=[],
+                   help="decode-RNG seeds to sweep (default: the trainer's "
+                        "cfg.seed+777). The SBM samples its graph during "
+                        "eval too, so test/dev BLEU is a random variable in "
+                        "the decode key — sweeping seeds measures that "
+                        "variance (discovered r5: ±0.3+ BLEU on the 200-"
+                        "sample test split)")
     args = p.parse_args()
 
     os.environ.setdefault("JAX_PLATFORMS", "cpu")
@@ -77,6 +87,8 @@ def main() -> None:
         dims["compute_dtype"] = run_args["compute_dtype"]
     if args.compute_dtype:
         dims["compute_dtype"] = args.compute_dtype
+    if args.eval_graph:
+        dims["eval_graph"] = args.eval_graph
     if run_args.get("floor"):
         dims["sbm_floor"] = float(run_args["floor"])
     if run_args.get("seed"):
@@ -98,28 +110,35 @@ def main() -> None:
         int(d) for d in os.listdir(ck_dir) if d.isdigit())
     assert epochs, f"no checkpoints under {ck_dir}"
 
+    eval_seeds = args.eval_seeds or [cfg.seed + 777]
     results = []
     for ep in epochs:
-        t0 = time.time()
         st, _ = restore_latest(ck_dir, state, ep)
-        hyps, refs = [], []
-        for y_pred, target in _decode_dataset(
-            trainer.model, st.params, ds, cfg, jax.random.key(cfg.seed + 777),
-            trainer.decode_fn, host_shard=False,
-        ):
-            h, r = bleu_output_transform(y_pred, target, trainer.tgt_vocab.i2w)
-            hyps.extend(h)
-            refs.extend(r)
-        hypotheses = {i: [" ".join(x)] for i, x in enumerate(hyps)}
-        references = {i: [" ".join(x)] for i, x in enumerate(refs)}
-        bleu, rouge_l, meteor, _, _ = eval_accuracies(hypotheses, references)
-        rec = {"epoch": ep, "split": args.split, "bleu": round(bleu, 4),
-               "rouge_l": round(rouge_l, 4), "meteor": round(meteor, 4),
-               "wall_s": round(time.time() - t0, 1)}
-        results.append(rec)
-        print(json.dumps(rec), flush=True)
+        for es in eval_seeds:
+            t0 = time.time()
+            hyps, refs = [], []
+            for y_pred, target in _decode_dataset(
+                trainer.model, st.params, ds, cfg, jax.random.key(es),
+                trainer.decode_fn, host_shard=False,
+            ):
+                h, r = bleu_output_transform(y_pred, target, trainer.tgt_vocab.i2w)
+                hyps.extend(h)
+                refs.extend(r)
+            hypotheses = {i: [" ".join(x)] for i, x in enumerate(hyps)}
+            references = {i: [" ".join(x)] for i, x in enumerate(refs)}
+            bleu, rouge_l, meteor, _, _ = eval_accuracies(hypotheses, references)
+            rec = {"epoch": ep, "split": args.split, "eval_seed": es,
+                   "bleu": round(bleu, 4), "rouge_l": round(rouge_l, 4),
+                   "meteor": round(meteor, 4),
+                   "wall_s": round(time.time() - t0, 1)}
+            results.append(rec)
+            print(json.dumps(rec), flush=True)
 
     suffix = f"_{args.compute_dtype}" if args.compute_dtype else ""
+    if args.eval_graph:
+        suffix += f"_{args.eval_graph}"
+    if args.eval_seeds:
+        suffix += "_seeds"
     out = args.out or os.path.join(
         args.run_dir, f"reeval_{args.split}{suffix}.json")
     with open(out, "w") as f:
@@ -127,6 +146,7 @@ def main() -> None:
                    "eval_compute_dtype": cfg.compute_dtype,
                    "train_compute_dtype": run_args.get("compute_dtype") or
                    "float32",
+                   "eval_graph": cfg.eval_graph,
                    "results": results}, f, indent=1)
 
 
