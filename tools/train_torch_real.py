@@ -190,6 +190,10 @@ def main() -> None:
 
     if best_state is not None:
         model.load_state_dict(best_state)
+        # persist for decode-seed sweeps (r5: eval samples the SBM graph, so
+        # test BLEU carries σ≈0.2-0.3 decode noise — fair comparisons need
+        # the torch checkpoint re-decodable, not just its single draw)
+        torch.save(best_state, os.path.join(args.out, "best_model.pt"))
     bleu, rouge_l, meteor = evaluate(test_ds)
     summary = {
         "framework": "torch-reference",
