@@ -554,7 +554,9 @@ def main() -> None:
             # perf number — and differing noise_mode across variants means
             # differing Bernoulli streams, so cross-backend loss deltas are
             # expected, not a bug signal
-            if r["backend"] == "pallas" and r["device"] == "cpu":
+            # .get: legacy/hand-merged records may lack either key — the
+            # annotation is skipped, not the whole summary (ADVICE r5)
+            if r.get("backend") == "pallas" and r.get("device") == "cpu":
                 rec["interpret_mode"] = True
             if "noise_mode" in r:
                 rec["noise_mode"] = r["noise_mode"]

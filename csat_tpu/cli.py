@@ -34,6 +34,17 @@ def main() -> None:
                    help="emit a jax.profiler trace for the first epoch")
     p.add_argument("--backend", default="", choices=["", "xla", "pallas"])
     p.add_argument("--platform", default="", help="force jax platform (cpu/tpu)")
+    p.add_argument("--no_guard", action="store_true",
+                   help="disable the in-step non-finite guard "
+                        "(csat_tpu/resilience/guards.py)")
+    p.add_argument("--watchdog_timeout_s", type=float, default=-1.0,
+                   help="abort (resumable, exit 76) when no train step "
+                        "completes for this long; 0 disables, default "
+                        "keeps the config's value")
+    p.add_argument("--data_error_budget", type=int, default=-1,
+                   help="malformed training batches to quarantine-and-skip "
+                        "before failing loud; default keeps the config's "
+                        "value")
     args = p.parse_args()
 
     if args.platform:
@@ -60,6 +71,12 @@ def main() -> None:
         overrides["backend"] = args.backend
     if args.profile:
         overrides["profile"] = True
+    if args.no_guard:
+        overrides["nonfinite_guard"] = False
+    if args.watchdog_timeout_s >= 0:
+        overrides["watchdog_timeout_s"] = args.watchdog_timeout_s
+    if args.data_error_budget >= 0:
+        overrides["data_error_budget"] = args.data_error_budget
     overrides["scalar_log"] = True  # the CLI always streams scalars.jsonl
     cfg = get_config(args.config, **overrides)
 
@@ -82,11 +99,23 @@ def main() -> None:
 
     from csat_tpu.train.checkpoint import make_checkpoint_fn, save_params
 
-    ckpt_fn = make_checkpoint_fn(trainer.output_dir)
+    ckpt_fn = make_checkpoint_fn(
+        trainer.output_dir, retries=cfg.save_retries,
+        backoff_s=cfg.save_retry_backoff_s)
     # --resume honors an explicit --checkpoint_dir, else the output dir
     resume = (args.checkpoint_dir or True) if args.resume else False
-    state, history = trainer.fit(
-        train_ds, val_ds, checkpoint_fn=ckpt_fn, resume=resume)
+    from csat_tpu.resilience import EXIT_PREEMPTED, Preempted
+
+    try:
+        state, history = trainer.fit(
+            train_ds, val_ds, checkpoint_fn=ckpt_fn, resume=resume)
+    except Preempted as p:
+        # the snapshot is already durable — exit resumable (EX_TEMPFAIL)
+        # so a supervisor restarts with --resume and loses nothing
+        print(json.dumps({"preempted": True, "epoch": p.epoch,
+                          "iterations_done": p.iterations_done,
+                          "resume_from": p.directory}))
+        raise SystemExit(EXIT_PREEMPTED)
     # persist the best-by-val-BLEU weights (ref best_model file, train.py:200-208)
     save_params(trainer.output_dir, history["best_params"])
     scores = run_test(

@@ -152,6 +152,43 @@ class Config:
     # observability (cli --profile / scalars.jsonl stream; SURVEY §5)
     scalar_log: bool = False
     profile: bool = False
+    # --- resilience (csat_tpu/resilience/) ---
+    # in-step non-finite guard: detect NaN/Inf loss or grad-norm inside the
+    # jitted step and skip the optimizer update via lax.cond (donation
+    # preserved; the applied branch is bit-identical to the unguarded step)
+    nonfinite_guard: bool = True
+    # roll the state back to the last good host snapshot (taken at epoch
+    # starts) after this many CONSECUTIVE guarded steps, re-splitting the
+    # RNG so the retry samples a different Bernoulli path. 0 = never roll
+    # back (guard still skips bad updates)
+    guard_rollback_after: int = 3
+    # host-side cadence for reading the device-side consecutive-bad
+    # counter. Each read is a host-device sync, so 1 would serialize the
+    # host with the device and defeat async dispatch + prefetch on the
+    # production hot path; the default checks every 16 steps — bad
+    # updates are SKIPPED on-device regardless, the cadence only bounds
+    # how late a persistent divergence is noticed (rollback still fires:
+    # the consecutive counter keeps growing across the interval). Tests
+    # and debug runs set 1 for exact step-level accounting
+    guard_check_every: int = 16
+    # give up (TrainingDivergedError) after this many rollbacks per fit —
+    # a run that keeps diverging is broken, not unlucky
+    guard_max_rollbacks: int = 3
+    # SIGTERM/SIGINT → final synchronous checkpoint + resume marker
+    # (csat_tpu/resilience/preemption.py); fit raises Preempted after the
+    # snapshot is durable
+    preempt_save: bool = True
+    # step watchdog: abort with a resumable exit code when no train step
+    # completes for this long (the hung-RPC mode,
+    # results/perf/tpu_session_r4.md). 0 = disabled
+    watchdog_timeout_s: float = 0.0
+    # malformed-batch quarantine budget for the training data pipeline:
+    # how many bad batches may be skipped (logged with sample indices)
+    # before failing loud. 0 = fail on the first one
+    data_error_budget: int = 0
+    # bounded retry around checkpoint saves (periodic + preemption)
+    save_retries: int = 3
+    save_retry_backoff_s: float = 0.5
 
     @property
     def head_dim(self) -> int:
@@ -178,9 +215,19 @@ class Config:
         assert self.pad_row in ("zero", "frozen"), self.pad_row
         assert self.init_scheme in ("flax", "reference"), self.init_scheme
         assert self.eval_graph in ("sample", "expected"), self.eval_graph
+        assert self.guard_rollback_after >= 0, self.guard_rollback_after
+        assert self.guard_check_every >= 1, self.guard_check_every
+        assert self.guard_max_rollbacks >= 0, self.guard_max_rollbacks
+        assert self.watchdog_timeout_s >= 0, self.watchdog_timeout_s
+        assert self.data_error_budget >= 0, self.data_error_budget
+        assert self.save_retries >= 1, self.save_retries
         if self.eval_graph == "expected":
+            # a -1 entry is a fill placeholder whose size is unknown until
+            # build_mesh (it may well resolve to 1 device) — defer that
+            # case to the Trainer's post-build check instead of rejecting
+            # a valid config here (ADVICE r5)
             seq_sharded = any(
-                name == "seq" and size != 1 for name, size in self.mesh_shape)
+                name == "seq" and size > 1 for name, size in self.mesh_shape)
             if self.backend == "pallas" or seq_sharded:
                 # the expected-graph eval takes the plain dense route and
                 # would materialize (B,H,N,N) tensors — defeating exactly
@@ -356,3 +403,20 @@ def get_config(name: str, **overrides) -> Config:
 
 def list_configs() -> Tuple[str, ...]:
     return tuple(sorted(_REGISTRY))
+
+
+def config_from_dict(d: dict) -> Config:
+    """Rebuild a :class:`Config` from ``dataclasses.asdict`` output that
+    round-tripped through JSON (tools stamp it into ``summary.json`` as
+    ``resolved_config`` so re-evaluation never re-derives hyperparameters
+    from CLI sentinels). Tuple fields come back as lists; unknown keys
+    (fields from a newer/older schema) are dropped rather than fatal."""
+    known = {f.name for f in dataclasses.fields(Config)}
+    kw = {k: v for k, v in d.items() if k in known}
+    if "clusters" in kw:
+        kw["clusters"] = tuple(int(c) for c in kw["clusters"])
+    if "mesh_shape" in kw:
+        kw["mesh_shape"] = tuple((str(n), int(s)) for n, s in kw["mesh_shape"])
+    cfg = Config(**kw)
+    cfg.validate()
+    return cfg

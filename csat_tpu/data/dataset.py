@@ -348,6 +348,8 @@ def iterate_batches(
     drop_last: bool = True,
     num_shards: int = 1,
     shard_index: int = 0,
+    batch_hook=None,
+    on_batch_error=None,
 ) -> Iterator[Batch]:
     """Minibatch iterator with optional host-sharding (each host reads its
     own slice — the JAX-native replacement for ``DistributedSampler``,
@@ -358,6 +360,15 @@ def iterate_batches(
     partition. The index set is trimmed to a multiple of ``num_shards`` so
     every shard yields the same number of batches — required for lockstep
     multi-host collectives.
+
+    Resilience hooks (``csat_tpu/resilience``): ``batch_hook(chunk, batch)``
+    runs per produced batch (the fault harness injects corrupt batches
+    here); a collate/hook exception is offered to
+    ``on_batch_error(chunk, exc)`` — return True to quarantine-and-skip
+    the batch (the :class:`~csat_tpu.resilience.retry.ErrorBudget`
+    policy), anything else re-raises. The handling lives *inside* the
+    generator because a generator that raises is closed — skipping must
+    happen where iteration can continue.
     """
     idx = np.arange(len(dataset))
     if shuffle:
@@ -370,4 +381,12 @@ def iterate_batches(
         chunk = idx[s : s + batch_size]
         if drop_last and len(chunk) < batch_size:
             break
-        yield collate_indexed(dataset.arrays, chunk, dataset.config.max_src_len)
+        try:
+            batch = collate_indexed(dataset.arrays, chunk, dataset.config.max_src_len)
+            if batch_hook is not None:
+                batch = batch_hook(chunk, batch)
+        except Exception as e:  # noqa: BLE001 — policy decides, not us
+            if on_batch_error is not None and on_batch_error(chunk, e):
+                continue
+            raise
+        yield batch

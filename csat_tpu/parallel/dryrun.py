@@ -19,6 +19,7 @@ from csat_tpu.configs import Config, get_config
 from csat_tpu.data.toy import random_batch
 from csat_tpu.parallel.mesh import build_mesh, param_sharding, replicated, shard_batch
 from csat_tpu.train.loop import make_train_step
+from csat_tpu.utils.compat import use_mesh
 from csat_tpu.train.optimizer import AdamWState
 from csat_tpu.train.state import TrainState, create_train_state, default_optimizer, make_model
 
@@ -91,7 +92,7 @@ def dryrun_train_step(
     batch = shard_batch(batch, mesh)
 
     step = make_train_step(model, tx, cfg)
-    with jax.sharding.set_mesh(mesh):  # activates the model's seq constraints
+    with use_mesh(mesh):  # activates the model's seq constraints
         new_state, metrics = step(state, batch)
         loss = float(metrics["loss"])
         # one eval/decode step under the same mesh: the KV-cache scan decode

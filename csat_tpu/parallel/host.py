@@ -36,7 +36,9 @@ def initialize_multihost(
     environment) — the common local single-process case. When a coordinator
     IS configured, failures propagate: silently falling back to single-host
     would train N independent un-synced models."""
-    if jax.distributed.is_initialized():
+    from csat_tpu.utils.compat import distributed_initialized
+
+    if distributed_initialized():
         return
     explicit = any(
         v is not None for v in (coordinator_address, num_processes, process_id)

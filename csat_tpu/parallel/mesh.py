@@ -146,10 +146,12 @@ def shard_batch(batch: Batch, mesh: Mesh) -> Batch:
 
 def constrain(x: jax.Array, *axes) -> jax.Array:
     """``with_sharding_constraint`` against the ambient mesh set via
-    ``jax.sharding.set_mesh``; axis names absent from that mesh degrade to
-    ``None`` and outside any mesh this is the identity — so model code can
-    annotate unconditionally."""
-    mesh = jax.sharding.get_abstract_mesh()
+    :func:`csat_tpu.utils.compat.use_mesh`; axis names absent from that
+    mesh degrade to ``None`` and outside any mesh this is the identity —
+    so model code can annotate unconditionally."""
+    from csat_tpu.utils.compat import ambient_mesh
+
+    mesh = ambient_mesh()
     if mesh is None or not mesh.axis_names:
         return x
     spec = P(*[a if a in mesh.axis_names else None for a in axes])

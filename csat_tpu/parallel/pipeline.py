@@ -51,13 +51,15 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
+from csat_tpu.utils.compat import ambient_mesh, shard_map
+
 __all__ = ["gpipe_blocks", "pipeline_ready", "stack_layer_params"]
 
 
 def pipeline_ready(n_stages: int) -> bool:
     """True when the ambient mesh carries a ``pipe`` axis of exactly
     ``n_stages`` devices (set via ``jax.sharding.set_mesh``)."""
-    mesh = jax.sharding.get_abstract_mesh()
+    mesh = ambient_mesh()
     if mesh is None or "pipe" not in mesh.axis_names:
         return False
     return int(mesh.shape["pipe"]) == n_stages
@@ -89,7 +91,7 @@ def gpipe_blocks(
     with ``x_out`` sharded like ``x`` and ``sparsity`` of shape ``(L, H)``
     replicated.
     """
-    mesh = jax.sharding.get_abstract_mesh()
+    mesh = ambient_mesh()
     assert mesh is not None and "pipe" in mesh.axis_names, (
         "gpipe_blocks needs an ambient mesh with a 'pipe' axis "
         "(jax.sharding.set_mesh)"
@@ -132,12 +134,15 @@ def gpipe_blocks(
 
         # the carry must be marked varying over `pipe` up front (the loop
         # body makes it so via the stage params; scan demands equal types).
-        # pcast is the jax≥0.9 spelling; pvary the deprecated fallback.
+        # pcast is the jax≥0.9 spelling, pvary the deprecated fallback;
+        # pre-varying-types runtimes (≤0.4.x, check_rep=False) need no mark.
         zeros = jnp.zeros_like(x_all[0])
         if hasattr(jax.lax, "pcast"):
             buf0 = jax.lax.pcast(zeros, "pipe", to="varying")
-        else:  # pragma: no cover
+        elif hasattr(jax.lax, "pvary"):  # pragma: no cover
             buf0 = jax.lax.pvary(zeros, "pipe")
+        else:
+            buf0 = zeros
         _, (ys, sps) = jax.lax.scan(tick, buf0, jnp.arange(ticks))
         # the last stage's outputs at ticks P-1 .. T-1 are microbatches 0..M-1.
         # select (not multiply): bubble ticks stream garbage activations
@@ -163,7 +168,7 @@ def gpipe_blocks(
         sp_all = jax.lax.psum(full, "pipe")  # (L, H)
         return out, sp_all
 
-    out, sparsity = jax.shard_map(
+    out, sparsity = shard_map(
         per_device,
         mesh=mesh,
         in_specs=(P("pipe"), P(d), P(d), P("pipe"), P("pipe")),

@@ -47,6 +47,7 @@ import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
 from csat_tpu.models.ste import sample_graph
+from csat_tpu.utils.compat import ambient_mesh, axis_size, shard_map
 from csat_tpu.ops.hashrng import bits_to_uniform, hash_bits, noise_stride
 
 BIG = 1e30
@@ -64,7 +65,7 @@ def ring_active() -> bool:
     """True when the ambient mesh (``jax.sharding.set_mesh``) has a ``seq``
     axis of size > 1 — the only regime where the ring path differs from the
     plain computation."""
-    mesh = jax.sharding.get_abstract_mesh()
+    mesh = ambient_mesh()
     return _mesh_axis_size(mesh, "seq") > 1
 
 
@@ -126,7 +127,7 @@ def _ring_local(q, k, v, q_hat, k_hat, s_aff, pad, seeds, *, rate, n, h_total,
 
     ``q_hat is None`` selects the dense (FullAttention) variant."""
     b_loc, h_loc, nl, dh = q.shape
-    p = jax.lax.axis_size("seq")
+    p = axis_size("seq")
     my = jax.lax.axis_index("seq")
     row0 = my * nl
     stride = noise_stride(n)
@@ -169,7 +170,7 @@ def _ring_local(q, k, v, q_hat, k_hat, s_aff, pad, seeds, *, rate, n, h_total,
 def _ring_setup(n: int, h: int, sample_seed, dropout_seed, rate):
     """Shared shard_map plumbing for both ring variants: mesh-axis probing,
     divisibility check, seed stacking, spec construction, local-fn kwargs."""
-    mesh = jax.sharding.get_abstract_mesh()
+    mesh = ambient_mesh()
     p = _mesh_axis_size(mesh, "seq")
     if n % p != 0:
         raise ValueError(f"ring attention needs N ({n}) divisible by the seq"
@@ -219,7 +220,7 @@ def ring_sbm_attention(
     mesh, seeds, sp, kwargs = _ring_setup(
         n, h, sample_seed, dropout_seed, dropout_rate)
     kwargs["floor"] = float(floor)
-    out, graph_sums = jax.shard_map(
+    out, graph_sums = shard_map(
         partial(_ring_local, **kwargs),
         mesh=mesh,
         in_specs=(sp["q"], sp["q"], sp["q"], sp["q"], sp["q"], sp["aff"],
@@ -252,7 +253,7 @@ def ring_full_attention(
     n, h = q.shape[2], q.shape[1]
     mesh, seeds, sp, kwargs = _ring_setup(
         n, h, jnp.zeros((), jnp.int32), dropout_seed, dropout_rate)
-    out = jax.shard_map(
+    out = shard_map(
         partial(_full_local, **kwargs),
         mesh=mesh,
         in_specs=(sp["q"], sp["q"], sp["q"], sp["pad"], sp["rep"]),

@@ -1,0 +1,36 @@
+"""Fault tolerance for long training runs.
+
+The CSA-Trans training path is stochastic (Bernoulli-sampled attention
+graphs with a straight-through estimator) and, in production, runs for
+hours across preemptible accelerators. This package makes the trainer
+survive the failure modes our own session logs document
+(``results/perf/tpu_session_r4.md``: wedged backends, killed windows,
+lost last snapshots) instead of merely logging them:
+
+* :mod:`~csat_tpu.resilience.guards` — jit-compatible non-finite
+  detection on loss + global grad-norm that *skips* the optimizer update
+  via ``lax.cond``, plus host-side rollback to the last good snapshot
+  after K consecutive bad steps;
+* :mod:`~csat_tpu.resilience.preemption` — SIGTERM/SIGINT-driven final
+  synchronous checkpoint + resume marker, so ``fit(resume=True)`` loses
+  at most the in-flight step;
+* :mod:`~csat_tpu.resilience.watchdog` — a heartbeat thread that turns a
+  hung device step (the documented hung-RPC mode) into diagnostics plus a
+  resumable abort instead of an indefinite wedge;
+* :mod:`~csat_tpu.resilience.retry` — bounded retry/backoff for
+  checkpoint saves, and a quarantine-with-error-budget policy for
+  malformed data batches;
+* :mod:`~csat_tpu.resilience.faults` — a deterministic fault-injection
+  harness so every behavior above is exercised by tier-1 CPU tests.
+"""
+
+from csat_tpu.resilience.faults import CorruptBatchError, FaultInjector  # noqa: F401
+from csat_tpu.resilience.guards import (  # noqa: F401
+    TrainingDivergedError, guarded_apply, host_snapshot, restore_snapshot,
+)
+from csat_tpu.resilience.preemption import (  # noqa: F401
+    EXIT_PREEMPTED, Preempted, PreemptionHandler, read_resume_marker,
+    write_resume_marker,
+)
+from csat_tpu.resilience.retry import DataErrorBudgetExceeded, ErrorBudget, retry  # noqa: F401
+from csat_tpu.resilience.watchdog import EXIT_WATCHDOG, StepWatchdog  # noqa: F401

@@ -1,0 +1,125 @@
+"""Deterministic fault injection for the resilience machinery.
+
+Every mechanism in this package exists because of a failure that is hard
+to reproduce on demand — so none of them can be trusted on faith. The
+injector gives tier-1 CPU tests a deterministic way to create each fault
+at a chosen step:
+
+* **non-finite grads / loss spikes** — a per-step loss multiplier threaded
+  into the jitted train step (``NaN`` poisons loss *and* grads; a huge
+  finite spike overflows only the grad-norm, exercising the guard's
+  second leg);
+* **corrupt batches** — raised from the data pipeline's per-batch hook,
+  exactly where a malformed sample would break collate;
+* **preemption** — triggers the trainer's stop flag (or delivers a real
+  ``SIGTERM`` to the process) at a chosen step;
+* **hung step** — a host-side stall between heartbeats, standing in for
+  the wedged-RPC device hang;
+* **failing saves** — a wrapper that makes the first N checkpoint saves
+  raise, exercising the bounded retry.
+
+Step ordinals are global train-step attempts (0-based, counted by the
+Trainer across epochs within one ``fit`` call); batch ordinals count
+batches produced by the training iterator. Both are deterministic for a
+fixed config + corpus, which is what makes the tests assertions exact.
+"""
+
+from __future__ import annotations
+
+import math
+import os
+import signal
+import time
+from typing import Callable, Collection, Optional
+
+__all__ = ["CorruptBatchError", "FaultInjector"]
+
+
+class CorruptBatchError(RuntimeError):
+    """Stands in for any exception a malformed sample raises in collate."""
+
+
+class FaultInjector:
+    def __init__(
+        self,
+        nan_loss_steps: Collection[int] = (),
+        spike_steps: Collection[int] = (),
+        spike_scale: float = 1e30,
+        corrupt_batches: Collection[int] = (),
+        preempt_at_step: Optional[int] = None,
+        deliver_signal: bool = False,
+        hang_at_step: Optional[int] = None,
+        hang_seconds: float = 0.0,
+        save_failures: int = 0,
+        sleep: Callable[[float], None] = time.sleep,
+    ) -> None:
+        self.nan_loss_steps = frozenset(int(s) for s in nan_loss_steps)
+        self.spike_steps = frozenset(int(s) for s in spike_steps)
+        self.spike_scale = float(spike_scale)
+        self.corrupt_batches = frozenset(int(b) for b in corrupt_batches)
+        self.preempt_at_step = preempt_at_step
+        self.deliver_signal = deliver_signal
+        self.hang_at_step = hang_at_step
+        self.hang_seconds = float(hang_seconds)
+        self.save_failures_remaining = int(save_failures)
+        self._sleep = sleep
+        self._batch_ordinal = 0
+        self.injected_saves_failed = 0
+
+    # -- train-step faults -------------------------------------------------
+
+    def loss_scale(self, step: int) -> Optional[float]:
+        """Loss multiplier for global step ``step`` (None = no fault)."""
+        if step in self.nan_loss_steps:
+            return math.nan
+        if step in self.spike_steps:
+            return self.spike_scale
+        return None
+
+    def maybe_hang(self, step: int) -> None:
+        """Stall the loop between heartbeats, simulating a hung device
+        step from the watchdog's point of view."""
+        if self.hang_at_step is not None and step == self.hang_at_step:
+            self._sleep(self.hang_seconds)
+
+    def fire_preemption(self, step: int, handler) -> bool:
+        """Trigger preemption at the configured step — through the real
+        signal path when ``deliver_signal`` (the handler must be
+        installed), else directly on the handler's flag."""
+        if self.preempt_at_step is None or step != self.preempt_at_step:
+            return False
+        if self.deliver_signal:
+            os.kill(os.getpid(), signal.SIGTERM)
+        else:
+            handler.trigger()
+        return True
+
+    # -- data faults -------------------------------------------------------
+
+    def batch_hook(self, chunk_indices, batch):
+        """``iterate_batches`` per-batch hook: raises on the configured
+        batch ordinals, passes everything else through unchanged."""
+        ordinal = self._batch_ordinal
+        self._batch_ordinal += 1
+        if ordinal in self.corrupt_batches:
+            raise CorruptBatchError(
+                f"injected corrupt batch at ordinal {ordinal} "
+                f"(samples {list(map(int, chunk_indices))})")
+        return batch
+
+    # -- checkpoint faults -------------------------------------------------
+
+    def flaky_save(self, save_fn: Callable) -> Callable:
+        """Wrap a save function so its first ``save_failures`` calls raise
+        ``IOError`` — the transient-filesystem fault the retry bounds."""
+
+        def wrapped(*args, **kwargs):
+            if self.save_failures_remaining > 0:
+                self.save_failures_remaining -= 1
+                self.injected_saves_failed += 1
+                raise IOError(
+                    f"injected checkpoint save failure "
+                    f"({self.save_failures_remaining} more to come)")
+            return save_fn(*args, **kwargs)
+
+        return wrapped

@@ -1,0 +1,108 @@
+"""In-step non-finite guards and host-side rollback.
+
+A single NaN/Inf gradient — one bad Bernoulli draw interacting with bf16,
+one poisoned batch — silently corrupts every parameter through the AdamW
+moments and poisons the rest of a multi-hour run. The guard lives *inside*
+the jitted train step so detection is free of host round-trips: it checks
+the scaled loss and the global gradient norm, and applies the optimizer
+update under ``lax.cond`` so a bad step leaves params, moments and the
+consecutive-bad counter's reset untouched. Buffer donation is preserved —
+both branches consume the donated state buffers and the outputs alias them.
+
+Rollback is a host-side policy on top: :class:`~csat_tpu.train.loop.Trainer`
+keeps a host snapshot of the last known-good state (taken at epoch starts,
+where the state is synchronized anyway) and, after K *consecutive* guarded
+steps, restores it with a re-split RNG so the retry takes a different
+Bernoulli sample path instead of deterministically re-diverging.
+"""
+
+from __future__ import annotations
+
+from typing import Any, NamedTuple, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+__all__ = [
+    "TrainingDivergedError", "guarded_apply", "host_snapshot",
+    "restore_snapshot",
+]
+
+
+class TrainingDivergedError(RuntimeError):
+    """Raised when rollback retries are exhausted — the run cannot make
+    progress and continuing would only burn accelerator time."""
+
+
+def guarded_apply(
+    tx: optax.GradientTransformation,
+    params: Any,
+    opt_state: Any,
+    grads: Any,
+    total_loss: jnp.ndarray,
+    bad_steps: jnp.ndarray,
+) -> Tuple[Any, Any, jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """Apply the optimizer update only when loss and grad-norm are finite.
+
+    Returns ``(params, opt_state, ok, grad_norm, bad_steps)`` where ``ok``
+    is the per-step finiteness verdict and ``bad_steps`` the updated
+    consecutive-bad counter (reset on a good step). Pure jax — traceable
+    inside the jitted train step.
+    """
+    gnorm = optax.global_norm(grads)
+    ok = jnp.isfinite(total_loss) & jnp.isfinite(gnorm)
+
+    def apply(_):
+        updates, new_opt = tx.update(grads, opt_state, params)
+        return optax.apply_updates(params, updates), new_opt
+
+    def skip(_):
+        return params, opt_state
+
+    new_params, new_opt = jax.lax.cond(ok, apply, skip, None)
+    new_bad = jnp.where(ok, 0, bad_steps + 1).astype(jnp.int32)
+    return new_params, new_opt, ok, gnorm, new_bad
+
+
+class HostSnapshot(NamedTuple):
+    """Donation-safe host copy of a :class:`TrainState` (PRNG key stored as
+    raw key data — typed keys reject ``np.asarray``)."""
+
+    step: np.ndarray
+    params: Any
+    opt_state: Any
+    rng_data: np.ndarray
+
+
+def host_snapshot(state) -> HostSnapshot:
+    """Detach ``state`` to host NumPy copies. The train step donates its
+    buffers, so the snapshot must not alias device memory."""
+    return HostSnapshot(
+        step=np.asarray(state.step),
+        params=jax.tree.map(np.asarray, state.params),
+        opt_state=jax.tree.map(np.asarray, state.opt_state),
+        rng_data=np.asarray(jax.random.key_data(state.rng)),
+    )
+
+
+def restore_snapshot(snap: HostSnapshot, resplit: int = 0):
+    """Rebuild a :class:`TrainState` from a snapshot.
+
+    ``resplit > 0`` folds the rollback ordinal into the PRNG key, so a
+    retry after rollback draws a *different* Bernoulli graph / dropout
+    path — replaying the exact trajectory that just diverged would diverge
+    again at the same step.
+    """
+    from csat_tpu.train.state import TrainState
+
+    rng = jax.random.wrap_key_data(jnp.asarray(snap.rng_data))
+    if resplit:
+        rng = jax.random.fold_in(rng, 0x5E511 + resplit)
+    return TrainState(
+        step=jnp.asarray(snap.step),
+        params=jax.tree.map(jnp.asarray, snap.params),
+        opt_state=jax.tree.map(jnp.asarray, snap.opt_state),
+        rng=rng,
+    )

@@ -1,0 +1,152 @@
+"""Preemption safety: signal-triggered final checkpoint + resume marker.
+
+Preemptible TPU VMs deliver SIGTERM with a short grace window; an unhandled
+one loses everything since the last ``save_interval`` checkpoint. The
+handler here only sets a flag — the training loop polls it at step
+granularity, performs one final *synchronous* checkpoint of the full train
+state, writes a resume marker recording how many iterations of the
+in-flight epoch completed, and raises :class:`Preempted`. On
+``fit(resume=True)`` the marker replays the epoch's deterministic shuffle,
+skips the completed iterations, and continues bit-identically — at most
+the in-flight step is lost, never a ``save_interval`` window.
+
+The preemption snapshot lives in its own ``preempt/`` subdirectory (its
+step key is the *in-progress* epoch, which would collide with the
+boundary checkpoints' completed-epoch keys in one orbax manager).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import json
+import os
+import signal
+import threading
+from typing import Iterator, Optional, Tuple
+
+__all__ = [
+    "EXIT_PREEMPTED", "Preempted", "PreemptionHandler",
+    "preempt_dir", "read_resume_marker", "snapshot_step",
+    "write_resume_marker",
+]
+
+# sysexits EX_TEMPFAIL: "try again later" — schedulers treat it as resumable
+EXIT_PREEMPTED = 75
+
+_MARKER = "resume_marker.json"
+
+
+class Preempted(RuntimeError):
+    """Raised by the training loop after the final checkpoint is durable.
+
+    Carries the checkpoint location so callers (CLI, tests) can report
+    where to resume from before exiting with :data:`EXIT_PREEMPTED`."""
+
+    def __init__(self, directory: str, epoch: int, iterations_done: int):
+        super().__init__(
+            f"preempted during epoch {epoch} after {iterations_done} "
+            f"iterations; resumable checkpoint at {directory}")
+        self.directory = directory
+        self.epoch = epoch
+        self.iterations_done = iterations_done
+
+
+class PreemptionHandler:
+    """Latching stop-flag settable from a signal, a thread, or a test.
+
+    The signal handler does nothing but set an event (async-signal-safe);
+    all checkpoint work happens in the training loop at a step boundary,
+    where the state is well-defined.
+    """
+
+    def __init__(self) -> None:
+        self._flag = threading.Event()
+        self._signum: Optional[int] = None
+
+    @property
+    def triggered(self) -> bool:
+        return self._flag.is_set()
+
+    def trigger(self, signum: Optional[int] = None) -> None:
+        """Request a graceful stop (signal handler / fault harness)."""
+        self._signum = signum
+        self._flag.set()
+
+    @contextlib.contextmanager
+    def installed(self, signals: Tuple[int, ...] = (signal.SIGTERM, signal.SIGINT)) -> Iterator["PreemptionHandler"]:
+        """Install the flag-setting handler for ``signals``, restoring the
+        previous handlers on exit. Outside the main thread (where Python
+        forbids ``signal.signal``) this degrades to flag-only mode — the
+        harness can still :meth:`trigger` programmatically."""
+        previous = {}
+        try:
+            for s in signals:
+                try:
+                    previous[s] = signal.signal(
+                        s, lambda signum, frame: self.trigger(signum))
+                except ValueError:  # not the main thread
+                    break
+            yield self
+        finally:
+            for s, old in previous.items():
+                signal.signal(s, old)
+
+
+def preempt_dir(checkpoint_dir: str) -> str:
+    """The preemption snapshot directory under a run's checkpoint dir."""
+    return os.path.join(checkpoint_dir, "preempt")
+
+
+# orbax step keys are integers; encode (epoch, iteration) injectively so a
+# second preemption in the same epoch (after a mid-epoch resume) gets a
+# fresh key instead of colliding with the first snapshot's
+_STEP_STRIDE = 10_000_000
+
+
+def snapshot_step(epoch: int, iterations_done: int) -> int:
+    """Orbax step key for a mid-epoch preemption snapshot."""
+    assert 0 <= iterations_done < _STEP_STRIDE, iterations_done
+    return int(epoch) * _STEP_STRIDE + int(iterations_done)
+
+
+def write_resume_marker(checkpoint_dir: str, epoch: int, iterations_done: int) -> str:
+    """Record that the preemption snapshot holds mid-epoch state: ``epoch``
+    is the in-flight epoch and ``iterations_done`` how many of its
+    iterations the saved state already contains. Written atomically
+    (rename) next to the snapshot."""
+    d = preempt_dir(checkpoint_dir)
+    os.makedirs(d, exist_ok=True)
+    path = os.path.join(d, _MARKER)
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump({"epoch": int(epoch),
+                   "iterations_done": int(iterations_done),
+                   "step": snapshot_step(epoch, iterations_done)}, f)
+    os.replace(tmp, path)
+    return path
+
+
+def read_resume_marker(checkpoint_dir: str) -> Optional[dict]:
+    """The resume marker, validated against the snapshot actually on disk.
+
+    Returns ``{"epoch": int, "iterations_done": int, "step": int}`` only
+    when the preemption manager's latest step matches the marker — a stale
+    marker (snapshot GC'd, partial write, marker from an older run layout)
+    is ignored rather than trusted."""
+    d = preempt_dir(checkpoint_dir)
+    path = os.path.join(d, _MARKER)
+    if not os.path.exists(path):
+        return None
+    try:
+        with open(path) as f:
+            marker = json.load(f)
+        epoch = int(marker["epoch"])
+        iterations = int(marker["iterations_done"])
+        step = int(marker["step"])
+    except (ValueError, KeyError, json.JSONDecodeError):
+        return None
+    from csat_tpu.train.checkpoint import latest_step
+
+    if latest_step(d) != step:
+        return None
+    return {"epoch": epoch, "iterations_done": iterations, "step": step}

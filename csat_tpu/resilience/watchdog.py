@@ -1,0 +1,143 @@
+"""Step watchdog: a heartbeat thread that refuses to wedge forever.
+
+The documented TPU failure mode (``results/perf/tpu_session_r4.md``, and
+the hung-RPC drain bound in ``train/checkpoint.py``) is a device step that
+never completes: the host blocks inside a runtime RPC and the job sits
+silently until a human kills it. The watchdog turns that into a bounded
+outage: the training loop beats once per completed step; if no beat
+arrives within the timeout while armed, the watchdog dumps diagnostics
+(all thread stacks — including where the main thread is stuck — via
+``faulthandler``) and invokes its timeout action, by default
+``os._exit(EXIT_WATCHDOG)`` so a supervisor can restart-and-resume.
+``os._exit`` is deliberate: a wedged runtime can hang interpreter
+finalizers, which is exactly the state we are escaping.
+
+The loop disarms the watchdog around phases with legitimately different
+cadence (validation decodes, checkpoint drains, first-step compilation);
+the next beat re-arms it.
+"""
+
+from __future__ import annotations
+
+import faulthandler
+import os
+import sys
+import threading
+import time
+from typing import Callable, Optional
+
+__all__ = ["EXIT_WATCHDOG", "StepWatchdog"]
+
+# sysexits EX_PROTOCOL is taken; 76 is conventionally free — distinct from
+# EXIT_PREEMPTED so supervisors can tell "hung hardware" from "preempted",
+# while both mean "resume me".
+EXIT_WATCHDOG = 76
+
+
+def _default_abort() -> None:  # pragma: no cover - exits the process
+    os._exit(EXIT_WATCHDOG)
+
+
+class StepWatchdog:
+    """Heartbeat monitor for the device step.
+
+    ``beat()`` marks progress and (re-)arms; ``disarm()`` suspends
+    monitoring between armed phases. The monitor thread polls at
+    ``timeout_s / 4`` granularity, so a hang is detected within
+    ``~1.25 × timeout_s`` of the last beat.
+    """
+
+    def __init__(
+        self,
+        timeout_s: float,
+        on_timeout: Optional[Callable[[], None]] = None,
+        diag_path: Optional[str] = None,
+        log: Callable[[str], None] = lambda m: print(m, file=sys.stderr),
+    ) -> None:
+        assert timeout_s > 0, timeout_s
+        self.timeout_s = float(timeout_s)
+        self._on_timeout = on_timeout or _default_abort
+        self._diag_path = diag_path
+        self._log = log
+        self._lock = threading.Lock()
+        self._armed = False
+        self._last_beat = 0.0
+        self._stop = threading.Event()
+        self._tripped = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def start(self) -> "StepWatchdog":
+        if self._thread is None:
+            self._thread = threading.Thread(
+                target=self._run, name="step-watchdog", daemon=True)
+            self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=self.timeout_s)
+            self._thread = None
+
+    def __enter__(self) -> "StepWatchdog":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.stop()
+
+    # -- heartbeat ---------------------------------------------------------
+
+    def beat(self) -> None:
+        """Record progress and arm (or re-arm) the monitor."""
+        with self._lock:
+            self._last_beat = time.monotonic()
+            self._armed = True
+
+    def disarm(self) -> None:
+        """Suspend monitoring (validation, checkpoint drain, compile)."""
+        with self._lock:
+            self._armed = False
+
+    @property
+    def tripped(self) -> bool:
+        return self._tripped.is_set()
+
+    # -- monitor -----------------------------------------------------------
+
+    def _run(self) -> None:
+        poll = self.timeout_s / 4.0
+        while not self._stop.wait(poll):
+            with self._lock:
+                armed, last = self._armed, self._last_beat
+            if armed and time.monotonic() - last > self.timeout_s:
+                self._trip(time.monotonic() - last)
+                return
+
+    def _trip(self, stalled_s: float) -> None:
+        self._tripped.set()
+        self._log(
+            f"# watchdog: no completed step for {stalled_s:.1f}s "
+            f"(timeout {self.timeout_s:.1f}s) — dumping diagnostics and "
+            "aborting with a resumable exit; the run can continue with "
+            "fit(resume=True)")
+        self._dump_diagnostics()
+        self._on_timeout()
+
+    def _dump_diagnostics(self) -> None:
+        """All thread stacks → stderr and (when configured) a diagnostics
+        file, so the post-mortem shows exactly which runtime call wedged."""
+        try:
+            faulthandler.dump_traceback(file=sys.stderr, all_threads=True)
+        except Exception:  # noqa: BLE001 — diagnostics must not mask abort
+            pass
+        if self._diag_path:
+            try:
+                os.makedirs(os.path.dirname(self._diag_path), exist_ok=True)
+                with open(self._diag_path, "w") as f:
+                    f.write(f"watchdog trip at monotonic {time.monotonic()}\n"
+                            f"timeout_s={self.timeout_s}\n")
+                    faulthandler.dump_traceback(file=f, all_threads=True)
+            except Exception:  # noqa: BLE001
+                pass

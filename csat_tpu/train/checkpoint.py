@@ -203,18 +203,40 @@ def restore_params(directory: str, name: str = "best_model") -> Any:
     return ckptr.restore(path)
 
 
-def make_checkpoint_fn(directory: str) -> Callable[[TrainState, int], None]:
+def make_checkpoint_fn(
+    directory: str,
+    retries: int = 3,
+    backoff_s: float = 0.5,
+    save: Optional[Callable[[str, TrainState, int], None]] = None,
+) -> Callable[[TrainState, int], None]:
     """Periodic-save hook for ``Trainer.fit`` (ref epoch snapshots,
     ``train.py:194-198``) — async so the save never stalls the epoch loop;
-    ``Trainer._fit`` waits for durability before returning."""
+    ``Trainer._fit`` waits for durability before returning.
+
+    The save call runs under bounded retry with exponential backoff
+    (``csat_tpu/resilience/retry.py``). Scope caveat: with the default
+    :func:`save_state_async`, the retry covers the submission half (d2h
+    fetch + enqueue, including the drain of the PREVIOUS save that orbax
+    performs there — so a deferred background failure from epoch N-1
+    surfaces here and the retry re-drains); a failure in THIS save's own
+    background serialize/commit still surfaces unretried at
+    ``wait_for_saves``/fit-end, because the donated device state it would
+    need for a re-save no longer exists. The synchronous preemption save
+    (``Trainer._preempt_save``) is retried end-to-end. ``save`` is
+    injectable (the fault harness substitutes a flaky one)."""
+    from csat_tpu.resilience.retry import retry
 
     ck_dir = os.path.join(directory, "checkpoints")
+    save = save or save_state_async
 
     def fn(state: TrainState, epoch: int) -> None:
-        save_state_async(ck_dir, state, epoch)
+        retry(save, ck_dir, state, epoch,
+              attempts=retries, backoff_s=backoff_s,
+              desc=f"checkpoint save (epoch {epoch}, {ck_dir})")
 
     # scoped durability barrier: Trainer waits on THIS run's directory only
     # (a process can host several trainers; an unscoped wait would serialize
     # them on each other's snapshots)
     fn.wait = lambda: wait_for_saves(ck_dir)
+    fn.directory = ck_dir
     return fn

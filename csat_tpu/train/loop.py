@@ -39,6 +39,7 @@ from csat_tpu.parallel import build_mesh, shard_batch
 from csat_tpu.train.decode import greedy_decode
 from csat_tpu.train.loss import label_smoothing_loss
 from csat_tpu.train.state import TrainState, create_train_state, default_optimizer, make_model
+from csat_tpu.utils.compat import use_mesh
 
 __all__ = ["make_train_step", "evaluate_bleu", "prefetch_batches", "run_test",
            "Trainer"]
@@ -104,8 +105,28 @@ def prefetch_batches(batches: Iterable[Batch], mesh, depth: int = 2) -> Iterator
 
 def make_train_step(
     model: CSATrans, tx: optax.GradientTransformation, cfg: Config
-) -> Callable[[TrainState, Batch], Tuple[TrainState, Dict[str, jnp.ndarray]]]:
-    def loss_fn(params, batch, dropout_key, sample_key):
+) -> Callable[..., Tuple[TrainState, Dict[str, jnp.ndarray]]]:
+    """One jitted, state-donating train step.
+
+    With ``cfg.nonfinite_guard`` (the default) the optimizer update runs
+    under the in-step non-finite guard
+    (:func:`csat_tpu.resilience.guards.guarded_apply`): a NaN/Inf loss or
+    grad-norm skips the update via ``lax.cond`` — params and AdamW moments
+    pass through untouched, the metrics carry ``nonfinite`` and the
+    consecutive-bad counter ``bad_steps``. The applied branch is
+    bit-identical to the unguarded step.
+
+    The returned callable accepts two extra optional arguments used by the
+    resilience machinery: ``bad_steps`` (the device-side consecutive-bad
+    counter threaded between calls by the Trainer; defaults to 0) and
+    ``loss_scale`` (a scalar multiplier on the total loss — the fault
+    harness injects NaN/spikes here; 1.0, the default, is an exact
+    float multiply and changes nothing). Callers using the plain
+    ``step(state, batch)`` form are unaffected.
+    """
+    guard = cfg.nonfinite_guard
+
+    def loss_fn(params, batch, dropout_key, sample_key, loss_scale):
         log_probs, sparsity, _, _, _ = model.apply(
             {"params": params},
             batch,
@@ -113,22 +134,69 @@ def make_train_step(
             rngs={"dropout": dropout_key, "sample": sample_key},
         )
         nll = label_smoothing_loss(log_probs, batch.target, cfg.smoothing)
-        total = nll + cfg.sw * sparsity
+        total = (nll + cfg.sw * sparsity) * loss_scale
         return total, (nll, sparsity)
 
     @partial(jax.jit, donate_argnums=(0,))
-    def train_step(state: TrainState, batch: Batch):
+    def _step(state: TrainState, batch: Batch, bad_steps, loss_scale):
         rng, dropout_key, sample_key = jax.random.split(state.rng, 3)
         (total, (nll, sparsity)), grads = jax.value_and_grad(loss_fn, has_aux=True)(
-            state.params, batch, dropout_key, sample_key
+            state.params, batch, dropout_key, sample_key, loss_scale
         )
-        updates, opt_state = tx.update(grads, state.opt_state, state.params)
-        params = optax.apply_updates(state.params, updates)
+        metrics = {"loss": nll, "sparsity": sparsity, "total": total}
+        if guard:
+            from csat_tpu.resilience.guards import guarded_apply
+
+            params, opt_state, ok, gnorm, bad = guarded_apply(
+                tx, state.params, state.opt_state, grads, total, bad_steps)
+            metrics.update(grad_norm=gnorm, nonfinite=~ok, bad_steps=bad)
+        else:
+            updates, opt_state = tx.update(grads, state.opt_state, state.params)
+            params = optax.apply_updates(state.params, updates)
         new_state = TrainState(
             step=state.step + 1, params=params, opt_state=opt_state, rng=rng
         )
-        return new_state, {"loss": nll, "sparsity": sparsity, "total": total}
+        return new_state, metrics
 
+    def _defaults(bad_steps, loss_scale):
+        return (jnp.zeros((), jnp.int32) if bad_steps is None else bad_steps,
+                jnp.asarray(1.0 if loss_scale is None else loss_scale,
+                            jnp.float32))
+
+    def train_step(state: TrainState, batch: Batch, bad_steps=None, loss_scale=None):
+        b, s = _defaults(bad_steps, loss_scale)
+        return _step(state, batch, b, s)
+
+    class _Compiled:
+        """AOT adapter: callers (bench.py) lower/compile the step and then
+        invoke it in the 2-arg form; the resilience operands are filled
+        with their defaults here."""
+
+        def __init__(self, compiled):
+            self._compiled = compiled
+
+        def __call__(self, state, batch, bad_steps=None, loss_scale=None):
+            b, s = _defaults(bad_steps, loss_scale)
+            return self._compiled(state, batch, b, s)
+
+        def __getattr__(self, name):
+            return getattr(self._compiled, name)
+
+    class _Lowered:
+        def __init__(self, lowered):
+            self._lowered = lowered
+
+        def compile(self, *a, **kw):
+            return _Compiled(self._lowered.compile(*a, **kw))
+
+        def __getattr__(self, name):
+            return getattr(self._lowered, name)
+
+    def lower(state, batch, bad_steps=None, loss_scale=None):
+        b, s = _defaults(bad_steps, loss_scale)
+        return _Lowered(_step.lower(state, batch, b, s))
+
+    train_step.lower = lower
     return train_step
 
 
@@ -184,7 +252,7 @@ def _decode_dataset(
             # constraints and the ring route inside the jitted decode (same
             # reason Trainer.fit wraps its loop) — scoped to the call so a
             # suspended/abandoned generator never leaks global mesh state
-            with jax.sharding.set_mesh(mesh):
+            with use_mesh(mesh):
                 y_pred = np.asarray(decode_fn(params, batch, sub))[:real]
         else:
             y_pred = np.asarray(decode_fn(params, batch, sub))[:real]
@@ -282,6 +350,13 @@ class Trainer:
         self.model = make_model(cfg, self.src_vocab.size(), self.tgt_vocab.size(), trip_size)
         self.tx = default_optimizer(cfg)
         self.mesh = build_mesh(cfg.mesh_shape)
+        if cfg.eval_graph == "expected" and dict(self.mesh.shape).get("seq", 1) > 1:
+            # deferred half of the configs.validate() guard: a ('seq', -1)
+            # fill placeholder is only resolvable once the mesh is built
+            raise ValueError(
+                "eval_graph='expected' runs the dense attention path; it "
+                f"does not compose with a sharded seq axis (mesh "
+                f"{dict(self.mesh.shape)})")
         self.train_step = make_train_step(self.model, self.tx, cfg)
         self.decode_fn = _decode_fn(self.model)
         self.output_dir = os.path.join(cfg.output_dir, cfg.project_name, cfg.task_name)
@@ -290,6 +365,11 @@ class Trainer:
         # init-parity A/Bs (tools/torch_init.py). Optimizer moments start
         # at zero either way.
         self.initial_params = None
+        # resilience hooks: a csat_tpu.resilience.faults.FaultInjector for
+        # deterministic fault drills, and a watchdog timeout override for
+        # tests (None = the production abort, os._exit(EXIT_WATCHDOG))
+        self.fault_injector = None
+        self.watchdog_on_timeout = None
 
     def init_state(self, example: Batch) -> TrainState:
         state = create_train_state(self.model, self.tx, example, self.cfg.seed)
@@ -325,8 +405,33 @@ class Trainer:
         # the ambient mesh activates the model's `seq`/`data` sharding
         # constraints (csat_tpu/parallel/mesh.py:constrain) inside the
         # jitted step — without it sequence parallelism would be inert
-        with jax.sharding.set_mesh(self.mesh):
+        with use_mesh(self.mesh):
             return self._fit(train_ds, val_ds, num_epochs, checkpoint_fn, resume)
+
+    def _preempt_save(self, ck_dir: str, state: TrainState, epoch: int,
+                      it_done: int) -> None:
+        """Final synchronous snapshot + resume marker (the SIGTERM path).
+
+        Runs under bounded retry — the grace window is short, but one
+        flaky-filesystem blip must not cost the whole snapshot. Multi-host
+        caveat: orbax saves are collective, so this relies on every host
+        receiving the signal (the usual preemption contract); coordinated
+        abort for partial signals is a ROADMAP open item."""
+        from csat_tpu.resilience.preemption import (
+            preempt_dir, snapshot_step, write_resume_marker,
+        )
+        from csat_tpu.resilience.retry import retry
+        from csat_tpu.train.checkpoint import save_state
+
+        self.log(f"preemption: saving synchronous snapshot "
+                 f"(epoch {epoch}, {it_done} iterations done) under {ck_dir}")
+        retry(save_state, preempt_dir(ck_dir), state,
+              snapshot_step(epoch, it_done),
+              attempts=self.cfg.save_retries,
+              backoff_s=self.cfg.save_retry_backoff_s,
+              desc="preemption checkpoint", log=self.log)
+        if jax.process_index() == 0:
+            write_resume_marker(ck_dir, epoch, it_done)
 
     def _fit(
         self,
@@ -336,95 +441,230 @@ class Trainer:
         checkpoint_fn: Optional[Callable[[TrainState, int], None]] = None,
         resume=False,
     ) -> Tuple[TrainState, Dict[str, Any]]:
+        import contextlib
+
+        from csat_tpu.resilience import (
+            ErrorBudget, Preempted, PreemptionHandler, StepWatchdog,
+            TrainingDivergedError, host_snapshot, restore_snapshot,
+        )
+
         cfg = self.cfg
         num_epochs = num_epochs or cfg.num_epochs
         example = next(iterate_batches(train_ds, cfg.batch_size, shuffle=False))
         state = self.init_state(example)
         start_epoch = 1
+        skip_iterations = 0
         best_bleu, best_params = 0.0, None
         best_meta = os.path.join(self.output_dir, "best.json")
+        ck_dir = getattr(checkpoint_fn, "directory", None) or os.path.join(
+            self.output_dir, "checkpoints")
         if resume:
             # full-state resume (params + AdamW moments + RNG + step): the
             # continuation reproduces the uninterrupted run exactly, since
             # the per-epoch shuffle is seeded by cfg.seed + epoch.
             # ``resume`` may be a checkpoint directory; True means the run's
-            # own output dir.
-            from csat_tpu.train.checkpoint import latest_step, restore_latest
-
-            ckpt_dir = (
-                resume if isinstance(resume, str) and resume
-                else os.path.join(self.output_dir, "checkpoints")
+            # own output dir. A preemption snapshot newer than the newest
+            # boundary checkpoint resumes MID-epoch: the marker replays the
+            # epoch's deterministic shuffle and skips the completed
+            # iterations, so at most the in-flight step was lost.
+            from csat_tpu.resilience.preemption import (
+                preempt_dir, read_resume_marker,
             )
+            from csat_tpu.train.checkpoint import (
+                latest_step, restore_latest, restore_state,
+            )
+
+            ckpt_dir = resume if isinstance(resume, str) and resume else ck_dir
             found = latest_step(ckpt_dir)
-            resumed = found is not None
-            if resumed:
+            marker = read_resume_marker(ckpt_dir)
+            resumed = True
+            if marker is not None and (found is None or marker["epoch"] > found):
+                state = restore_state(
+                    preempt_dir(ckpt_dir), state, marker["step"])
+                start_epoch = marker["epoch"]
+                skip_iterations = marker["iterations_done"]
+                self.log(
+                    f"resumed mid-epoch {start_epoch} after "
+                    f"{skip_iterations} iterations (preemption snapshot, "
+                    f"{ckpt_dir})")
+            elif found is not None:
                 state, done_epoch = restore_latest(ckpt_dir, state, found)
                 start_epoch = done_epoch + 1
                 self.log(f"resumed from epoch {done_epoch} ({ckpt_dir})")
+            else:
+                resumed = False
+                self.log(f"no checkpoint under {ckpt_dir}; starting fresh")
+            if resumed and os.path.exists(best_meta):
                 # carry the pre-kill best-by-val-BLEU forward so the resumed
                 # run cannot overwrite best_model with worse weights
-                if os.path.exists(best_meta):
-                    with open(best_meta) as f:
-                        best_bleu = float(json.load(f).get("bleu", 0.0))
-            else:
-                self.log(f"no checkpoint under {ckpt_dir}; starting fresh")
+                with open(best_meta) as f:
+                    best_bleu = float(json.load(f).get("bleu", 0.0))
         else:
             resumed = False
         eval_key = jax.random.key(cfg.seed + 777)
-        history: Dict[str, Any] = {"loss": [], "val_bleu": [], "best_bleu": best_bleu}
-        for epoch in range(start_epoch, num_epochs + 1):
-            if cfg.profile and epoch == start_epoch:
-                # one profiled epoch: the jax.profiler trace is the TPU
-                # analogue of the reference's torch.cuda.Event harness
-                # (csa_trans_time_memory.py:103-158; SURVEY §5)
-                jax.profiler.start_trace(os.path.join(self.output_dir, "trace"))
-            t0 = time.time()
-            losses = []
-            for it, batch in enumerate(prefetch_batches(
-                iterate_batches(
-                    train_ds, cfg.batch_size, shuffle=True, seed=cfg.seed + epoch,
-                    num_shards=jax.process_count(),
-                    shard_index=jax.process_index(),
-                ),
-                self.mesh, depth=cfg.prefetch,
-            )):
-                state, metrics = self.train_step(state, batch)
-                losses.append(metrics["loss"])
-                if it % 50 == 0 and cfg.scalar_log:
-                    # per-iteration scalar cadence mirrors the reference's
-                    # every-50-iters TensorBoard loss (train.py:212-217).
-                    # Gated on scalar_log so the float() device sync never
-                    # stalls the async dispatch pipeline when nobody reads it
-                    self._scalar(epoch=epoch, it=it, loss=float(metrics["loss"]))
-            if cfg.profile and epoch == start_epoch:
-                jax.block_until_ready(losses[-1])
-                jax.profiler.stop_trace()
-            mean_loss = float(jnp.mean(jnp.stack(losses)))
-            history["loss"].append(mean_loss)
-            self._scalar(epoch=epoch, loss=mean_loss, wall_s=round(time.time() - t0, 1))
-            msg = f"epoch {epoch}: loss={mean_loss:.4f} ({time.time()-t0:.1f}s)"
-            if val_ds is not None and (epoch % cfg.val_interval == 0 or epoch == num_epochs):
-                bleu = evaluate_bleu(
-                    self.model, state.params, val_ds, cfg, self.tgt_vocab, eval_key,
-                    self.decode_fn, mesh=self.mesh,
-                )
-                history["val_bleu"].append((epoch, bleu))
-                self._scalar(epoch=epoch, val_bleu=bleu)
-                if bleu > history["best_bleu"]:
-                    history["best_bleu"] = bleu
-                    best_params = jax.tree.map(np.asarray, state.params)
-                    if checkpoint_fn is not None and jax.process_index() == 0:
-                        # persist the best immediately (ref best-model file,
-                        # train.py:200-208) so a later kill+resume keeps it
-                        from csat_tpu.train.checkpoint import save_params
+        history: Dict[str, Any] = {
+            "loss": [], "val_bleu": [], "best_bleu": best_bleu,
+            "rollbacks": 0, "nonfinite_steps": 0, "quarantined": 0,
+        }
 
-                        save_params(self.output_dir, best_params)
-                        with open(best_meta, "w") as f:
-                            json.dump({"bleu": bleu, "epoch": epoch}, f)
-                msg += f" val_bleu={bleu:.4f}"
-            if checkpoint_fn is not None and epoch % cfg.save_interval == 0:
-                checkpoint_fn(state, epoch)
-            self.log(msg)
+        # --- resilience plumbing (csat_tpu/resilience/) ---
+        injector = self.fault_injector
+        guard_on = cfg.nonfinite_guard
+        rollback_after = cfg.guard_rollback_after if guard_on else 0
+        preempt = PreemptionHandler()
+        budget = ErrorBudget(cfg.data_error_budget, log=self.log)
+        on_batch_error = (
+            budget if (cfg.data_error_budget > 0 or injector is not None)
+            else None)
+        global_step = 0   # train-step attempts this fit — fault ordinals
+        bad_dev = None    # device-side consecutive-non-finite counter
+
+        with contextlib.ExitStack() as stack:
+            if cfg.preempt_save:
+                stack.enter_context(preempt.installed())
+            watchdog = None
+            if cfg.watchdog_timeout_s > 0:
+                watchdog = stack.enter_context(StepWatchdog(
+                    cfg.watchdog_timeout_s,
+                    on_timeout=self.watchdog_on_timeout,
+                    diag_path=os.path.join(
+                        self.output_dir, "watchdog_diagnostics.txt"),
+                    log=self.log))
+            for epoch in range(start_epoch, num_epochs + 1):
+                if preempt.triggered:
+                    # signal arrived between epochs (validation/checkpoint
+                    # phase): snapshot at the epoch boundary
+                    self._preempt_save(ck_dir, state, epoch, 0)
+                    raise Preempted(ck_dir, epoch, 0)
+                # rollback anchor: the last state known good at a sync point
+                snapshot = host_snapshot(state) if rollback_after else None
+                if cfg.profile and epoch == start_epoch:
+                    # one profiled epoch: the jax.profiler trace is the TPU
+                    # analogue of the reference's torch.cuda.Event harness
+                    # (csa_trans_time_memory.py:103-158; SURVEY §5)
+                    jax.profiler.start_trace(os.path.join(self.output_dir, "trace"))
+                t0 = time.time()
+                skip = skip_iterations if epoch == start_epoch else 0
+                while True:
+                    # one epoch ATTEMPT: a guard rollback abandons the
+                    # attempt and replays the whole epoch from the restored
+                    # snapshot (same deterministic batch order, re-split
+                    # RNG) — continuing mid-epoch from epoch-start params
+                    # would silently drop the already-consumed batches from
+                    # training and desynchronize it_done from what the
+                    # state actually contains (the preemption marker relies
+                    # on that correspondence)
+                    losses = []
+                    rolled_back = False
+                    batches: Iterable[Batch] = iterate_batches(
+                        train_ds, cfg.batch_size, shuffle=True, seed=cfg.seed + epoch,
+                        num_shards=jax.process_count(),
+                        shard_index=jax.process_index(),
+                        batch_hook=injector.batch_hook if injector else None,
+                        on_batch_error=on_batch_error,
+                    )
+                    if skip:
+                        import itertools
+
+                        batches = itertools.islice(batches, skip, None)
+                    it_done = skip
+                    for it, batch in enumerate(prefetch_batches(
+                        batches, self.mesh, depth=cfg.prefetch,
+                    )):
+                        loss_scale = injector.loss_scale(global_step) if injector else None
+                        if injector is not None:
+                            injector.maybe_hang(global_step)
+                        state, metrics = self.train_step(
+                            state, batch, bad_steps=bad_dev, loss_scale=loss_scale)
+                        bad_dev = metrics.get("bad_steps")
+                        it_done += 1
+                        if watchdog is not None:
+                            watchdog.beat()
+                        losses.append(metrics["loss"])
+                        if it % 50 == 0 and cfg.scalar_log:
+                            # per-iteration scalar cadence mirrors the reference's
+                            # every-50-iters TensorBoard loss (train.py:212-217).
+                            # Gated on scalar_log so the float() device sync never
+                            # stalls the async dispatch pipeline when nobody reads it
+                            self._scalar(epoch=epoch, it=it, loss=float(metrics["loss"]))
+                        if injector is not None:
+                            injector.fire_preemption(global_step, preempt)
+                        global_step += 1
+                        if preempt.triggered:
+                            if watchdog is not None:
+                                watchdog.disarm()
+                            self._preempt_save(ck_dir, state, epoch, it_done)
+                            raise Preempted(ck_dir, epoch, it_done)
+                        if guard_on and it % cfg.guard_check_every == 0:
+                            # the device-side counter is authoritative: bad>0
+                            # means the LAST step was non-finite (it resets
+                            # on good); the read is a host-device sync, so
+                            # guard_check_every trades detection latency
+                            # against async-dispatch overlap
+                            bad = int(metrics["bad_steps"])
+                            if bad > 0:
+                                history["nonfinite_steps"] += 1
+                                self.log(
+                                    f"guard: non-finite step skipped (epoch "
+                                    f"{epoch} it {it}; {bad} consecutive)")
+                            if rollback_after and bad >= rollback_after:
+                                if history["rollbacks"] >= cfg.guard_max_rollbacks:
+                                    raise TrainingDivergedError(
+                                        f"{bad} consecutive non-finite steps "
+                                        f"after {history['rollbacks']} rollbacks "
+                                        f"(epoch {epoch} it {it}) — aborting")
+                                history["rollbacks"] += 1
+                                state = restore_snapshot(
+                                    snapshot, resplit=history["rollbacks"])
+                                bad_dev = None
+                                rolled_back = True
+                                self.log(
+                                    f"guard: rollback #{history['rollbacks']} — "
+                                    f"{bad} consecutive non-finite steps at "
+                                    f"epoch {epoch} it {it}; restored the "
+                                    "epoch-start snapshot with a re-split rng; "
+                                    "replaying the epoch")
+                                break
+                    if not rolled_back:
+                        break
+                if watchdog is not None:
+                    # validation decodes / checkpoint drains run at their own
+                    # cadence — the next train step's beat re-arms
+                    watchdog.disarm()
+                if cfg.profile and epoch == start_epoch and losses:
+                    jax.block_until_ready(losses[-1])
+                    jax.profiler.stop_trace()
+                # nanmean: identical to mean on healthy epochs; a guarded
+                # run's skipped steps may log NaN losses without poisoning
+                # the epoch statistic
+                mean_loss = (float(jnp.nanmean(jnp.stack(losses)))
+                             if losses else float("nan"))
+                history["loss"].append(mean_loss)
+                self._scalar(epoch=epoch, loss=mean_loss, wall_s=round(time.time() - t0, 1))
+                msg = f"epoch {epoch}: loss={mean_loss:.4f} ({time.time()-t0:.1f}s)"
+                if val_ds is not None and (epoch % cfg.val_interval == 0 or epoch == num_epochs):
+                    bleu = evaluate_bleu(
+                        self.model, state.params, val_ds, cfg, self.tgt_vocab, eval_key,
+                        self.decode_fn, mesh=self.mesh,
+                    )
+                    history["val_bleu"].append((epoch, bleu))
+                    self._scalar(epoch=epoch, val_bleu=bleu)
+                    if bleu > history["best_bleu"]:
+                        history["best_bleu"] = bleu
+                        best_params = jax.tree.map(np.asarray, state.params)
+                        if checkpoint_fn is not None and jax.process_index() == 0:
+                            # persist the best immediately (ref best-model file,
+                            # train.py:200-208) so a later kill+resume keeps it
+                            from csat_tpu.train.checkpoint import save_params
+
+                            save_params(self.output_dir, best_params)
+                            with open(best_meta, "w") as f:
+                                json.dump({"bleu": bleu, "epoch": epoch}, f)
+                    msg += f" val_bleu={bleu:.4f}"
+                if checkpoint_fn is not None and epoch % cfg.save_interval == 0:
+                    checkpoint_fn(state, epoch)
+                self.log(msg)
+        history["quarantined"] = budget.count
         if checkpoint_fn is not None:
             # epoch snapshots persist asynchronously (checkpoint.py) —
             # make them durable before handing the state back; scoped to

@@ -1,0 +1,89 @@
+"""JAX version compatibility: the ambient-mesh and shard_map surfaces.
+
+The codebase targets the current JAX API (``jax.sharding.set_mesh`` /
+``jax.sharding.get_abstract_mesh`` / ``jax.shard_map``); older runtimes
+(≤ 0.4.x, still common on pinned TPU images) expose the same capability
+through ``with mesh:`` (the thread-local resource env) and
+``jax.experimental.shard_map``. Routing every ambient-mesh touch through
+this module keeps model/parallel code version-agnostic.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import jax
+
+__all__ = ["use_mesh", "ambient_mesh", "shard_map", "axis_size",
+           "distributed_initialized"]
+
+
+def distributed_initialized() -> bool:
+    """Whether the multi-host process group is already up.
+
+    ``jax.distributed.is_initialized`` where available; older runtimes
+    expose the same fact as a non-None client on the distributed global
+    state."""
+    fn = getattr(jax.distributed, "is_initialized", None)
+    if fn is not None:
+        return bool(fn())
+    state = getattr(jax.distributed, "global_state", None)
+    return state is not None and getattr(state, "client", None) is not None
+
+
+def axis_size(name: str):
+    """Size of a mapped mesh axis from inside ``shard_map``/``pmap``.
+
+    ``jax.lax.axis_size`` where available; else the classic
+    ``psum(1, axis)`` idiom, which XLA constant-folds to the same value.
+    """
+    fn = getattr(jax.lax, "axis_size", None)
+    if fn is not None:
+        return fn(name)
+    return jax.lax.psum(1, name)
+
+
+def use_mesh(mesh) -> Any:
+    """Context manager activating ``mesh`` as the ambient mesh.
+
+    New JAX: ``jax.sharding.set_mesh``. Old JAX: a physical ``Mesh`` is
+    itself the context manager that pushes the thread-local resource env
+    consumed by ``with_sharding_constraint`` and ``shard_map``.
+    """
+    set_mesh = getattr(jax.sharding, "set_mesh", None)
+    if set_mesh is not None:
+        return set_mesh(mesh)
+    return mesh
+
+
+def ambient_mesh() -> Optional[Any]:
+    """The currently-active ambient mesh, or ``None`` outside any mesh.
+
+    Both branches return an object exposing ``.axis_names`` and ``.shape``
+    (a name→size mapping), which is all the callers consume.
+    """
+    get = getattr(jax.sharding, "get_abstract_mesh", None)
+    if get is not None:
+        return get()
+    from jax.interpreters import pxla
+
+    m = pxla.thread_resources.env.physical_mesh
+    return None if m.empty else m
+
+
+def shard_map(f, mesh=None, in_specs=None, out_specs=None, check_vma=None):
+    """``jax.shard_map`` where available, else the 0.4.x experimental one.
+
+    ``check_vma`` maps onto the legacy ``check_rep``; the legacy checker
+    has known false positives around psum/ppermute patterns, so when the
+    caller did not opt in it is disabled on the fallback path (it is a
+    static analysis only — numerics are identical either way).
+    """
+    sm = getattr(jax, "shard_map", None)
+    if sm is not None:
+        kw = {} if check_vma is None else {"check_vma": check_vma}
+        return sm(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs, **kw)
+    from jax.experimental.shard_map import shard_map as legacy
+
+    return legacy(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                  check_rep=bool(check_vma))

@@ -31,6 +31,35 @@ def synthetic_corpus(tmp_path_factory):
 
 
 @pytest.fixture(scope="session")
+def micro_config():
+    """Smallest config that still trains: ~half the compile time of
+    ``tiny_config``. For tests whose subject is the training *loop*
+    machinery (resilience drills, kill/resume), not model capacity."""
+    from csat_tpu.configs import get_config
+
+    return get_config(
+        "python",
+        pe_dim=8,
+        pegen_dim=16,
+        sbm_enc_dim=32,
+        hidden_size=32,
+        num_heads=2,
+        num_layers=1,
+        sbm_layers=1,
+        clusters=(4,),
+        dim_feed_forward=64,
+        decoder_layers=2,
+        max_src_len=48,
+        max_tgt_len=10,
+        batch_size=8,
+        dropout=0.1,
+        attention_dropout=0.0,
+        tree_pos_width=4,
+        tree_pos_height=8,
+    )
+
+
+@pytest.fixture(scope="session")
 def tiny_config():
     from csat_tpu.configs import get_config
 

@@ -83,6 +83,56 @@ def test_kill_and_resume_reproduces_loss_curve(tiny_config, synthetic_corpus, tm
     )
 
 
+def test_sigterm_preemption_resume_bit_identical(micro_config, synthetic_corpus, tmp_path):
+    """Preemption safety end-to-end (ISSUE 1 tentpole): a real SIGTERM
+    mid-epoch triggers a final synchronous snapshot + resume marker, and
+    ``fit(resume=True)`` continues BIT-identically with the uninterrupted
+    run — params, AdamW moments, RNG and the in-epoch batch position all
+    restore, so at most the in-flight step is lost (vs a full
+    save_interval without the handler)."""
+    import os
+
+    from csat_tpu.data.dataset import ASTDataset
+    from csat_tpu.resilience import FaultInjector, Preempted
+    from csat_tpu.resilience.preemption import read_resume_marker
+    from csat_tpu.train import Trainer
+
+    cfg = micro_config.replace(
+        data_dir=synthetic_corpus, full_att=True, num_epochs=3,
+        val_interval=99, save_interval=99, output_dir=str(tmp_path / "run"),
+    )
+    # run A (uninterrupted reference) shares the Trainer with the killed
+    # run B — A touches no on-disk state (no val, no checkpoint_fn), so
+    # the only cross-talk would be a bug in fit()'s own state handling
+    trainer = Trainer(cfg, log=lambda s: None)
+    ds = ASTDataset(cfg, "train", trainer.src_vocab, trainer.tgt_vocab)
+    state_a, hist_a = trainer.fit(ds, None)
+
+    # killed run: a REAL SIGTERM delivered mid-epoch-2 (12 batches/epoch,
+    # global step 17 = epoch 2, iteration 6)
+    trainer.fault_injector = FaultInjector(preempt_at_step=17, deliver_signal=True)
+    try:
+        with pytest.raises(Preempted):
+            trainer.fit(ds, None)
+    finally:
+        trainer.fault_injector = None
+    ck_dir = os.path.join(trainer.output_dir, "checkpoints")
+    marker = read_resume_marker(ck_dir)
+    assert marker is not None and marker["epoch"] == 2
+
+    # brand-new process stand-in: a fresh Trainer resumes from the snapshot
+    tr_b2 = Trainer(cfg, log=lambda s: None)
+    state_b, hist_b = tr_b2.fit(ds, None, resume=True)
+
+    assert int(state_b.step) == int(state_a.step) == 36
+    for x, y in zip(jax.tree.leaves(state_a.params), jax.tree.leaves(state_b.params)):
+        np.testing.assert_array_equal(np.asarray(x), np.asarray(y))
+    # the first full epoch after the resume point matches exactly
+    assert hist_b["loss"][-1] == hist_a["loss"][-1]
+    assert (jax.random.key_data(state_b.rng).tolist()
+            == jax.random.key_data(state_a.rng).tolist())
+
+
 def test_async_save_roundtrip(tmp_path, tiny_config):
     """save_state_async + wait_for_saves must be restore-equivalent to the
     blocking save (same on-disk format, donation-safe detached copies)."""

@@ -27,6 +27,7 @@ os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
 import jax
 jax.config.update("jax_platforms", "cpu")
 
+from csat_tpu.utils.compat import use_mesh
 from csat_tpu.parallel.host import initialize_multihost, global_mesh, is_primary
 
 coord, pid = sys.argv[1], int(sys.argv[2])
@@ -74,7 +75,7 @@ state = jax.tree.map(
 )
 state = state.replace(rng=jax.random.wrap_key_data(state.rng))
 step = make_train_step(model, tx, cfg)
-with jax.sharding.set_mesh(mesh):
+with use_mesh(mesh):
     state, metrics = step(state, batch)
     loss = float(metrics["loss"])
 # digest of the (replicated-after-psum) updated params, to compare across hosts
@@ -117,7 +118,7 @@ args = (
     g(s_aff, P(), slice(None)),
     g(pad, P(None, "seq"), (slice(None), rows)),
 )
-with jax.sharding.set_mesh(mesh):
+with use_mesh(mesh):
     out, gs = jax.jit(lambda *a: ring_sbm_attention(*a, SEED))(*args)
     # gs is replicated over the mesh: every addressable shard holds the
     # full (B, H) array

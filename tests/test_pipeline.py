@@ -12,6 +12,7 @@ import jax.numpy as jnp
 import numpy as np
 import pytest
 
+from csat_tpu.utils.compat import use_mesh
 from csat_tpu.configs import get_config
 from csat_tpu.models.sbm import SBMBlock
 from csat_tpu.parallel.mesh import build_mesh
@@ -109,7 +110,7 @@ def test_wavefront_matches_sequential_microbatched(pipe, n_micro, data, remat):
         block_apply = jax.checkpoint(block_apply)
 
     stacked = stack_layer_params(layer_params)
-    with jax.sharding.set_mesh(mesh):
+    with use_mesh(mesh):
         assert pipeline_ready(pipe)
         out, sp = jax.jit(
             lambda s, xx, pp: gpipe_blocks(
@@ -163,7 +164,7 @@ def test_wavefront_with_dropout_matches_sequential():
         return y, sp
 
     mesh = build_mesh((("data", 2), ("pipe", 2)))
-    with jax.sharding.set_mesh(mesh):
+    with use_mesh(mesh):
         out, _ = jax.jit(
             lambda s, xx, pp: gpipe_blocks(
                 block_apply, s, xx, pp, skeys, dkeys, 2, 2
@@ -207,7 +208,7 @@ def test_wavefront_bf16_matches_sequential():
         return y, sp
 
     mesh = build_mesh((("data", 2), ("pipe", 2)))
-    with jax.sharding.set_mesh(mesh):
+    with use_mesh(mesh):
         out, _ = jax.jit(
             lambda s, xx, pp: gpipe_blocks(
                 block_apply, s, xx, pp, skeys, None, 2, 2
@@ -227,10 +228,10 @@ def test_pipeline_ready_gating():
     assert cfg.pipeline_stages == 4
     # no ambient mesh → not ready
     assert not pipeline_ready(4)
-    with jax.sharding.set_mesh(build_mesh((("data", 2), ("pipe", 4)))):
+    with use_mesh(build_mesh((("data", 2), ("pipe", 4)))):
         assert pipeline_ready(4)
         assert not pipeline_ready(2)  # wrong stage count
-    with jax.sharding.set_mesh(build_mesh((("data", 8),))):
+    with use_mesh(build_mesh((("data", 8),))):
         assert not pipeline_ready(4)  # no pipe axis
 
 
@@ -335,7 +336,7 @@ def _run_train_step_body(cfg):
     host_state = jax.tree.map(jnp.copy, state)  # snapshot: step donates
     state = jax.device_put(state, replicated(mesh))
     batch = shard_batch(batch, mesh)
-    with jax.sharding.set_mesh(mesh):
+    with use_mesh(mesh):
         new_state, metrics = step(state, batch)
         loss = float(metrics["loss"])
         assert np.isfinite(loss)

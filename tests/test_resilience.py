@@ -1,0 +1,282 @@
+"""Fault drills: every resilience mechanism exercised deterministically.
+
+Each test injects a specific fault through the harness
+(``csat_tpu/resilience/faults.py``) and asserts the exact recovery
+behavior — nothing here is probabilistic or timing-lucky except the
+watchdog's detection latency, which is bounded by construction.
+"""
+
+import os
+import threading
+
+import jax
+import numpy as np
+import pytest
+
+from csat_tpu.data.dataset import ASTDataset, iterate_batches
+from csat_tpu.resilience import (
+    CorruptBatchError, DataErrorBudgetExceeded, ErrorBudget, FaultInjector,
+    Preempted, PreemptionHandler, StepWatchdog, TrainingDivergedError, retry,
+)
+from csat_tpu.train import Trainer
+from csat_tpu.train.checkpoint import make_checkpoint_fn
+from csat_tpu.train.state import create_train_state
+
+
+@pytest.fixture(scope="module")
+def rig(synthetic_corpus, micro_config, tmp_path_factory):
+    """One shared Trainer (one jit compile) reused across fault drills.
+
+    12 batches/epoch (96 samples / batch 8); rollback threshold 2 so two
+    injected bad steps trigger it; watchdog enabled with a generous
+    timeout and a no-op abort (tests swap in a recorder)."""
+    cfg = micro_config.replace(
+        data_dir=synthetic_corpus, full_att=True, num_epochs=1,
+        val_interval=99, save_interval=99,
+        guard_rollback_after=2, guard_max_rollbacks=2, guard_check_every=1,
+        data_error_budget=2, watchdog_timeout_s=3.0,
+        output_dir=str(tmp_path_factory.mktemp("resilience_rig")),
+    )
+    trainer = Trainer(cfg, log=lambda s: None)
+    trainer.watchdog_on_timeout = lambda: None  # never abort the test run
+    ds = ASTDataset(cfg, "train", trainer.src_vocab, trainer.tgt_vocab)
+    return cfg, trainer, ds
+
+
+def _tree_equal(a, b):
+    for x, y in zip(jax.tree.leaves(a), jax.tree.leaves(b)):
+        np.testing.assert_array_equal(np.asarray(x), np.asarray(y))
+
+
+# --------------------------------------------------------------------------
+# in-step non-finite guard
+# --------------------------------------------------------------------------
+
+
+def test_nonfinite_step_skipped_params_unchanged(rig):
+    """A NaN loss skips the update (params bit-unchanged), sets the
+    nonfinite flag and increments the consecutive-bad counter; a huge
+    finite spike trips the grad-norm leg; a good step resets the counter
+    and finally updates."""
+    cfg, trainer, ds = rig
+    batch = next(iterate_batches(ds, cfg.batch_size, shuffle=False))
+    state = create_train_state(trainer.model, trainer.tx, batch, seed=0)
+    p0 = jax.tree.map(np.asarray, state.params)
+
+    state, m = trainer.train_step(state, batch, loss_scale=float("nan"))
+    assert bool(m["nonfinite"]) and int(m["bad_steps"]) == 1
+    assert int(state.step) == 1  # attempts are counted either way
+    _tree_equal(state.params, p0)
+
+    state, m = trainer.train_step(
+        state, batch, bad_steps=m["bad_steps"], loss_scale=float("nan"))
+    assert int(m["bad_steps"]) == 2
+    _tree_equal(state.params, p0)
+
+    # spike: total stays finite but the squared grad-norm overflows —
+    # the guard's second leg
+    state, m = trainer.train_step(
+        state, batch, bad_steps=m["bad_steps"], loss_scale=1e30)
+    assert bool(m["nonfinite"]) and int(m["bad_steps"]) == 3
+    assert np.isfinite(float(m["total"]))
+    assert np.isinf(float(m["grad_norm"]))
+    _tree_equal(state.params, p0)
+
+    state, m = trainer.train_step(state, batch, bad_steps=m["bad_steps"])
+    assert not bool(m["nonfinite"]) and int(m["bad_steps"]) == 0
+    moved = any(
+        not np.array_equal(np.asarray(x), np.asarray(y))
+        for x, y in zip(jax.tree.leaves(state.params), jax.tree.leaves(p0)))
+    assert moved, "good step after bad streak did not update params"
+
+
+def test_rollback_after_k_consecutive_and_quarantine(rig):
+    """K=2 consecutive injected NaN steps roll the state back to the
+    epoch-start snapshot and REPLAY the epoch (so the batches consumed
+    before the rollback are retrained, not silently dropped); a corrupt
+    batch in the same run is quarantined under the error budget; training
+    completes with finite loss."""
+    cfg, trainer, ds = rig
+    trainer.fault_injector = FaultInjector(
+        nan_loss_steps=(4, 5), corrupt_batches=(1,))
+    try:
+        state, hist = trainer.fit(ds, None)
+    finally:
+        trainer.fault_injector = None
+    assert hist["rollbacks"] == 1
+    assert hist["nonfinite_steps"] == 2
+    assert hist["quarantined"] == 1
+    assert np.isfinite(hist["loss"][0])
+    # first attempt: 12 batches - 1 quarantined, NaN at attempts 5-6 →
+    # rollback to the step-0 snapshot; replay attempt: all 12 batches
+    # clean (fault ordinals are global, the quarantine ordinal was already
+    # consumed) → the full epoch lands on the counter
+    assert int(state.step) == 12
+
+
+def test_rollback_budget_exhausted_raises(rig):
+    """Persistent divergence (every step NaN) exhausts guard_max_rollbacks
+    and fails loud instead of spinning forever."""
+    cfg, trainer, ds = rig
+    trainer.fault_injector = FaultInjector(nan_loss_steps=range(64))
+    try:
+        with pytest.raises(TrainingDivergedError):
+            trainer.fit(ds, None)
+    finally:
+        trainer.fault_injector = None
+
+
+# --------------------------------------------------------------------------
+# step watchdog
+# --------------------------------------------------------------------------
+
+
+def test_watchdog_unit_trip_and_disarm(tmp_path):
+    ev = threading.Event()
+    diag = str(tmp_path / "wd" / "diag.txt")
+    with StepWatchdog(0.3, on_timeout=ev.set, diag_path=diag,
+                      log=lambda m: None) as wd:
+        wd.beat()
+        assert ev.wait(2.0), "watchdog did not trip on a stalled beat"
+        assert wd.tripped
+    assert os.path.exists(diag)
+
+    ev2 = threading.Event()
+    with StepWatchdog(0.3, on_timeout=ev2.set, log=lambda m: None) as wd2:
+        wd2.beat()
+        wd2.disarm()
+        assert not ev2.wait(0.8), "disarmed watchdog tripped"
+
+
+def test_watchdog_trips_on_hung_step(rig):
+    """An injected mid-epoch stall (the hung-RPC stand-in) trips the
+    watchdog within its timeout; training then continues once the hang
+    clears (the test's on_timeout records instead of aborting)."""
+    cfg, trainer, ds = rig
+    ev = threading.Event()
+    trainer.watchdog_on_timeout = ev.set
+    trainer.fault_injector = FaultInjector(hang_at_step=5, hang_seconds=8.0)
+    try:
+        _, hist = trainer.fit(ds, None)
+    finally:
+        trainer.fault_injector = None
+        trainer.watchdog_on_timeout = lambda: None
+    assert ev.is_set(), "hung step did not trip the watchdog"
+    assert os.path.exists(
+        os.path.join(trainer.output_dir, "watchdog_diagnostics.txt"))
+    assert np.isfinite(hist["loss"][0])
+
+
+# --------------------------------------------------------------------------
+# checkpoint save retry
+# --------------------------------------------------------------------------
+
+
+def test_save_succeeds_under_retry(tmp_path):
+    saved = []
+    inj = FaultInjector(save_failures=2)
+    fn = make_checkpoint_fn(
+        str(tmp_path), retries=3, backoff_s=0.0,
+        save=inj.flaky_save(lambda d, s, e: saved.append((d, e))))
+    fn(object(), 7)
+    assert inj.injected_saves_failed == 2
+    assert saved == [(os.path.join(str(tmp_path), "checkpoints"), 7)]
+
+
+def test_save_retry_bounded(tmp_path):
+    inj = FaultInjector(save_failures=5)
+    fn = make_checkpoint_fn(
+        str(tmp_path), retries=2, backoff_s=0.0,
+        save=inj.flaky_save(lambda d, s, e: None))
+    with pytest.raises(IOError):
+        fn(object(), 1)
+    assert inj.injected_saves_failed == 2  # bounded: 2 attempts, not 5
+
+
+def test_retry_helper_backoff_sequence():
+    delays = []
+    attempts = {"n": 0}
+
+    def flaky():
+        attempts["n"] += 1
+        if attempts["n"] < 3:
+            raise OSError("transient")
+        return "done"
+
+    out = retry(flaky, attempts=4, backoff_s=0.1, log=lambda m: None,
+                sleep=delays.append)
+    assert out == "done"
+    assert delays == [0.1, 0.2]  # exponential, bounded by success
+
+
+# --------------------------------------------------------------------------
+# data-pipeline quarantine
+# --------------------------------------------------------------------------
+
+
+def test_error_budget_exhaustion_fails_loud(rig):
+    cfg, trainer, ds = rig
+    inj = FaultInjector(corrupt_batches=(0, 1))
+    budget = ErrorBudget(1, log=lambda m: None)
+    it = iterate_batches(ds, cfg.batch_size, shuffle=False,
+                         batch_hook=inj.batch_hook, on_batch_error=budget)
+    with pytest.raises(DataErrorBudgetExceeded):
+        list(it)
+    assert budget.count == 1  # first corrupt batch quarantined, second fatal
+
+
+def test_corrupt_batch_skipped_within_budget(rig):
+    cfg, trainer, ds = rig
+    inj = FaultInjector(corrupt_batches=(2,))
+    budget = ErrorBudget(2, log=lambda m: None)
+    batches = list(iterate_batches(
+        ds, cfg.batch_size, shuffle=False,
+        batch_hook=inj.batch_hook, on_batch_error=budget))
+    assert len(batches) == 11  # 12 minus the quarantined one
+    assert budget.count == 1 and budget.quarantined[0] == list(range(16, 24))
+
+
+def test_corrupt_error_without_handler_propagates(rig):
+    """Default posture (no budget, no injector): the pipeline fails loud
+    with the original exception, exactly as before."""
+    cfg, trainer, ds = rig
+    inj = FaultInjector(corrupt_batches=(0,))
+    with pytest.raises(CorruptBatchError):
+        list(iterate_batches(ds, cfg.batch_size, shuffle=False,
+                             batch_hook=inj.batch_hook))
+
+
+# --------------------------------------------------------------------------
+# preemption plumbing (the end-to-end kill/resume drill lives in
+# tests/test_checkpoint.py::test_sigterm_preemption_resume_bit_identical)
+# --------------------------------------------------------------------------
+
+
+def test_preemption_handler_flag_and_restore():
+    import signal
+
+    h = PreemptionHandler()
+    before = signal.getsignal(signal.SIGTERM)
+    with h.installed((signal.SIGTERM,)):
+        assert not h.triggered
+        os.kill(os.getpid(), signal.SIGTERM)
+        # CPython runs the handler between bytecodes — by the time the
+        # flag is polled it must be set
+        for _ in range(1000):
+            if h.triggered:
+                break
+        assert h.triggered
+    assert signal.getsignal(signal.SIGTERM) is before
+
+
+def test_resume_marker_roundtrip_and_stale_rejection(tmp_path):
+    from csat_tpu.resilience.preemption import (
+        read_resume_marker, snapshot_step, write_resume_marker,
+    )
+
+    ck = str(tmp_path / "checkpoints")
+    write_resume_marker(ck, epoch=3, iterations_done=5)
+    # no snapshot on disk at the marker's step → the marker is stale and
+    # must be ignored, not trusted
+    assert read_resume_marker(ck) is None
+    assert snapshot_step(3, 5) != snapshot_step(3, 6) != snapshot_step(4, 5)

@@ -12,6 +12,7 @@ import numpy as np
 import pytest
 from jax.sharding import NamedSharding, PartitionSpec as P
 
+from csat_tpu.utils.compat import use_mesh
 from csat_tpu.parallel import build_mesh
 from csat_tpu.parallel.ring import ring_sbm_attention
 from tests.test_flash_ops import DSEED, SEED, _inputs, _xla_mirror
@@ -34,7 +35,7 @@ def test_ring_matches_mirror():
     mesh = _ring_mesh()
     args = _inputs(b=2, h=2, n=128, dh=32, kk=5)
     out_x, gs_x = _xla_mirror(*args, SEED)
-    with jax.sharding.set_mesh(mesh):
+    with use_mesh(mesh):
         sharded = _shard(mesh, *args)
         out_r, gs_r = jax.jit(
             lambda *a: ring_sbm_attention(*a, SEED)
@@ -46,7 +47,7 @@ def test_ring_matches_mirror():
 def test_ring_rejects_indivisible_n():
     mesh = _ring_mesh(data=2, seq=4)
     args = _inputs(b=2, h=2, n=126, dh=8, kk=3)
-    with jax.sharding.set_mesh(mesh):
+    with use_mesh(mesh):
         with pytest.raises(ValueError, match="divisible"):
             ring_sbm_attention(*args, SEED)
 
@@ -63,7 +64,7 @@ def test_ring_512_matches_mirror():
     mesh = _ring_mesh(data=1, seq=4)
     args = _inputs(b=1, h=2, n=512, dh=16, kk=4)
     out_x, gs_x = _xla_mirror(*args, SEED)
-    with jax.sharding.set_mesh(mesh):
+    with use_mesh(mesh):
         sharded = _shard(mesh, *args)
         out_r, gs_r = jax.jit(
             lambda *a: ring_sbm_attention(*a, SEED)
@@ -77,7 +78,7 @@ def test_ring_dropout_matches_mirror():
     mesh = _ring_mesh()
     args = _inputs(b=2, h=2, n=128, dh=16, kk=4)
     out_x, gs_x = _xla_mirror(*args, SEED, rate=0.2, drop_seed=DSEED)
-    with jax.sharding.set_mesh(mesh):
+    with use_mesh(mesh):
         sharded = _shard(mesh, *args)
         out_r, gs_r = jax.jit(
             lambda *a: ring_sbm_attention(*a, SEED, 0.2, DSEED)
@@ -103,7 +104,7 @@ def test_ring_grads_match_mirror():
 
     gx = jax.grad(loss(_xla_mirror), argnums=(0, 1, 2, 3, 4, 5))(
         q, k, v, q_hat, k_hat, s_aff)
-    with jax.sharding.set_mesh(mesh):
+    with use_mesh(mesh):
         gr = jax.jit(jax.grad(
             loss(ring_sbm_attention), argnums=(0, 1, 2, 3, 4, 5)
         ))(q, k, v, q_hat, k_hat, s_aff)
@@ -119,7 +120,7 @@ def test_ring_under_tensor_parallel_matches_mirror():
     args = _inputs(b=1, h=4, n=128, dh=16, kk=4)
     out_x, gs_x = _xla_mirror(*args, SEED)
     qs = NamedSharding(mesh, P(None, "model", "seq", None))
-    with jax.sharding.set_mesh(mesh):
+    with use_mesh(mesh):
         sharded = (
             *(jax.device_put(t, qs) for t in args[:5]),
             jax.device_put(args[5], NamedSharding(mesh, P("model"))),
@@ -145,7 +146,7 @@ def test_ring_full_attention_matches_dense():
     dot = jnp.einsum("bhnd,bhmd->bhnm", q, k) / math.sqrt(q.shape[-1])
     attn = jax.nn.softmax(jnp.where(mask, -jnp.inf, dot), axis=-1)
     out_x = jnp.einsum("bhnm,bhmd->bhnd", attn, v)
-    with jax.sharding.set_mesh(mesh):
+    with use_mesh(mesh):
         sharded = _shard(mesh, q, k, v, q, q, jnp.zeros((2, 3, 3)), pad)
         q_s, k_s, v_s, pad_s = sharded[0], sharded[1], sharded[2], sharded[6]
         out_r = jax.jit(lambda *a: ring_full_attention(*a))(q_s, k_s, v_s, pad_s)
@@ -175,7 +176,7 @@ def test_ring_full_attention_grads_match_dense():
         return ring_full_attention(q, k, v, pad)
 
     gx = jax.grad(lambda *a: jnp.sum(dense(*a) * go), argnums=(0, 1, 2))(q, k, v)
-    with jax.sharding.set_mesh(mesh):
+    with use_mesh(mesh):
         gr = jax.jit(jax.grad(
             lambda *a: jnp.sum(ring(*a) * go), argnums=(0, 1, 2)
         ))(q, k, v)

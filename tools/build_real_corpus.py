@@ -71,9 +71,12 @@ def harvest(max_samples: int, seed: int = 0, site_packages: bool = False) -> lis
         if root == roots[0]:
             skip += ("site-packages",)
         for base, dirs, names in os.walk(root):
-            if any(p in base[len(root):] for p in skip):
-                dirs[:] = []
-                continue
+            # prune by exact directory NAME, not path substring: a
+            # substring match on 'test' also pruned pytest/, latest/,
+            # unittest/, … silently shrinking the --site_packages harvest
+            # (ADVICE r5). Pruning dirs in place is sufficient — os.walk
+            # then never descends into a skipped component at all
+            dirs[:] = [d for d in dirs if d not in skip]
             files.extend(os.path.join(base, n) for n in names if n.endswith(".py"))
     files.sort()
 

@@ -69,36 +69,61 @@ def main() -> None:
     from csat_tpu.train.loop import _decode_dataset
     from csat_tpu.metrics import bleu_output_transform, eval_accuracies
 
-    # rebuild the cfg exactly as tools/train_real.py did for this run
-    from tools.pair_common import cpu_dims
+    if "resolved_config" in summary:
+        # new-style summaries carry the fully-resolved Config — no sentinel
+        # re-derivation at all (tools/train_real.py stamps it)
+        from csat_tpu.configs import config_from_dict
 
-    name = run_args.get("config") or (
-        "python_full_att" if run_args["variant"] == "full_att" else "python")
-    sequential = False
-    if run_args.get("config"):
-        sequential = get_config(run_args["config"]).pe_dim == 0
-    dims = {} if run_args.get("full_dims") else cpu_dims(
-        run_args.get("width") or 128, sequential=sequential)
-    if run_args.get("backend"):
-        dims["backend"] = run_args["backend"]
-    if run_args.get("num_heads"):
-        dims["num_heads"] = run_args["num_heads"]
-    if run_args.get("compute_dtype"):
-        dims["compute_dtype"] = run_args["compute_dtype"]
-    if args.compute_dtype:
-        dims["compute_dtype"] = args.compute_dtype
-    if args.eval_graph:
-        dims["eval_graph"] = args.eval_graph
-    if run_args.get("floor"):
-        dims["sbm_floor"] = float(run_args["floor"])
-    if run_args.get("seed"):
-        dims["seed"] = run_args["seed"]
-    if run_args.get("pad_row"):
-        dims["pad_row"] = run_args["pad_row"]
-    cfg = get_config(
-        name, data_dir=run_args["data_dir"],
-        batch_size=run_args["batch_size"], **dims,
-    )
+        cfg = config_from_dict(summary["resolved_config"])
+        overrides = {}
+        if args.compute_dtype:
+            overrides["compute_dtype"] = args.compute_dtype
+        if args.eval_graph:
+            overrides["eval_graph"] = args.eval_graph
+        if overrides:
+            cfg = cfg.replace(**overrides)
+            cfg.validate()
+    else:
+        # legacy summaries: rebuild the cfg as tools/train_real.py did.
+        # Unset sentinels are gated explicitly per field (ADVICE r5: bare
+        # truthiness silently dropped numeric-0.0 overrides): floor's
+        # sentinel is ""/None — a numeric 0.0 (the quirk-fix clamp) is a
+        # real value; num_heads/width can never legitimately be 0, and a
+        # 0 seed from the legacy argparse default meant "use the config
+        # default", matching what training actually ran with.
+        def _set(key, *, unset=(None, "")):
+            return run_args.get(key) not in unset
+
+        from tools.pair_common import cpu_dims
+
+        name = run_args.get("config") or (
+            "python_full_att" if run_args["variant"] == "full_att" else "python")
+        sequential = False
+        if run_args.get("config"):
+            sequential = get_config(run_args["config"]).pe_dim == 0
+        width = run_args.get("width")
+        dims = {} if run_args.get("full_dims") else cpu_dims(
+            width if width not in (None, 0) else 128, sequential=sequential)
+        if _set("backend"):
+            dims["backend"] = run_args["backend"]
+        if _set("num_heads", unset=(None, 0)):
+            dims["num_heads"] = run_args["num_heads"]
+        if _set("compute_dtype"):
+            dims["compute_dtype"] = run_args["compute_dtype"]
+        if args.compute_dtype:
+            dims["compute_dtype"] = args.compute_dtype
+        if args.eval_graph:
+            dims["eval_graph"] = args.eval_graph
+        if _set("floor"):
+            dims["sbm_floor"] = float(run_args["floor"])
+        if _set("seed", unset=(None, 0)):
+            dims["seed"] = run_args["seed"]
+        if _set("pad_row"):
+            dims["pad_row"] = run_args["pad_row"]
+        cfg = get_config(
+            name, data_dir=run_args["data_dir"],
+            batch_size=run_args["batch_size"], **dims,
+        )
 
     trainer = Trainer(cfg, log=lambda m: None)
     ds = ASTDataset(cfg, args.split, trainer.src_vocab, trainer.tgt_vocab)

@@ -55,6 +55,7 @@ def main() -> None:
 
     from csat_tpu.parallel import build_mesh
     from csat_tpu.parallel.ring import ring_sbm_attention
+    from csat_tpu.utils.compat import use_mesh
 
     report: dict = {"n": args.n, "device": jax.devices()[0].platform,
                     "n_devices": jax.device_count()}
@@ -73,7 +74,7 @@ def main() -> None:
 
     mesh = build_mesh((("data", 1), ("seq", 4)))
     qs = NamedSharding(mesh, P("data", None, "seq", None))
-    with jax.sharding.set_mesh(mesh):
+    with use_mesh(mesh):
         sharded = (
             *(jax.device_put(t, qs) for t in qargs[:5]),
             jax.device_put(qargs[5], NamedSharding(mesh, P())),

@@ -177,9 +177,15 @@ def main() -> None:
         trainer.model, history["best_params"], test_ds, cfg, trainer.tgt_vocab,
         jax.random.key(cfg.seed), output_dir=out_dir,
     )
+    import dataclasses
+
     summary = {
         "variant": args.variant,
         "config": {k: v for k, v in vars(args).items()},
+        # the fully-resolved Config, so downstream tools (reeval_ckpt)
+        # rebuild the run's exact hyperparameters instead of re-deriving
+        # them from CLI sentinels where 0/"" are ambiguous (ADVICE r5)
+        "resolved_config": dataclasses.asdict(cfg),
         "dims": {"sbm_enc_dim": cfg.sbm_enc_dim, "pe_dim": cfg.pe_dim,
                  "layers": [cfg.num_layers, cfg.sbm_layers, cfg.decoder_layers]},
         "loss_curve": history["loss"],
