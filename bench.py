@@ -102,13 +102,47 @@ def _probe() -> None:
     }))
 
 
+def _skewed_lengths(rng, size: int, n: int):
+    """Per-sample AST node counts with the real corpora's small-skew:
+    lognormal with median ≈ 0.3·N, clamped to [4, N]."""
+    import numpy as np
+
+    ls = (n * rng.lognormal(mean=-1.2, sigma=0.6, size=size)).astype(int)
+    return np.clip(ls, 4, n)
+
+
+def _apply_lengths(batch, lengths):
+    """Stamp per-sample real lengths onto a toy batch: ``num_node`` drives
+    the honest real-node accounting, and PAD-ing ``src_seq`` beyond each
+    length makes the attention masks see the same pad fraction a real
+    skewed batch would. Shapes (= compiled program and step time) are
+    untouched."""
+    import numpy as np
+
+    src = np.asarray(batch.src_seq).copy()
+    for i, l in enumerate(lengths):
+        src[i, int(l):] = 0
+    return batch._replace(
+        src_seq=src, num_node=np.asarray(lengths, np.int32))
+
+
 def _measure_one(spec: str, heartbeat=None) -> dict:
     """Measure one variant in the already-initialized backend session.
 
-    spec = "backend:dtype:platform:batch:steps", platform "default" or "cpu".
+    spec = "backend:dtype:platform:batch:steps[:mode]", platform "default"
+    or "cpu", mode "fixed" (default) or "bucketed" (length-bucketed
+    execution, ``csat_tpu/data/bucketing.py``). Both modes run the same
+    skewed-length synthetic workload and record, next to the historical
+    padded-node metric, an honest ``real_nodes_per_sec_per_chip`` that
+    counts only non-PAD nodes — the ratio between the two is the padding
+    tax the bucketed mode exists to kill.
     """
-    backend, dtype, platform, batch_size, n_steps = spec.split(":")
+    parts = spec.split(":")
+    backend, dtype, platform, batch_size, n_steps = parts[:5]
+    mode = parts[5] if len(parts) > 5 else "fixed"
     batch_size, n_steps = int(batch_size), int(n_steps)
+    if mode == "bucketed":
+        return _measure_bucketed(backend, dtype, batch_size, n_steps, heartbeat)
     import jax
     import numpy as np
 
@@ -130,6 +164,13 @@ def _measure_one(spec: str, heartbeat=None) -> dict:
     cfg = get_config("python", **overrides)
     src_v, tgt_v, trip_v = 10_000, 20_000, 1246
     batch = random_batch(cfg, cfg.batch_size, src_v, tgt_v, trip_v, seed=0)
+    # skewed real lengths (shapes unchanged — same compiled program and
+    # step time as the historical fully-real batch) so the real-node
+    # metric reflects what padding actually costs on corpus-like data
+    batch = _apply_lengths(
+        batch,
+        _skewed_lengths(np.random.default_rng(1), cfg.batch_size, cfg.max_src_len),
+    )
     batch = jax.tree.map(jax.device_put, batch)
     model = make_model(cfg, src_v, tgt_v, trip_v)
     tx = default_optimizer(cfg)
@@ -166,6 +207,10 @@ def _measure_one(spec: str, heartbeat=None) -> dict:
 
     n_chips = jax.device_count()
     nodes = cfg.batch_size * cfg.max_src_len * n_steps
+    # honest accounting: only non-PAD nodes count as work; the padded
+    # metric stays for vs_baseline continuity (the torch baseline is
+    # credited the same way)
+    real_nodes = int(np.sum(np.asarray(batch.num_node))) * n_steps
     try:  # peak HBM (VERDICT r3 #1); CPU backends expose no stats → 0
         peak = int((jax.devices()[0].memory_stats() or {})
                    .get("peak_bytes_in_use", 0))
@@ -175,6 +220,7 @@ def _measure_one(spec: str, heartbeat=None) -> dict:
         "ok": True,
         "backend": backend,
         "dtype": dtype,
+        "mode": "fixed",
         "noise_mode": cfg.noise_mode,
         "device": jax.devices()[0].platform,
         "n_chips": n_chips,
@@ -184,7 +230,132 @@ def _measure_one(spec: str, heartbeat=None) -> dict:
         "step_ms": round(dt / n_steps * 1e3, 2),
         "peak_hbm_gb": round(peak / 2**30, 3),
         "nodes_per_sec_per_chip": nodes / dt / n_chips,
+        "real_nodes_per_sec_per_chip": real_nodes / dt / n_chips,
         **xla_mem,
+    }
+
+
+def _measure_bucketed(backend: str, dtype: str, batch_size: int,
+                      n_steps: int, heartbeat=None) -> dict:
+    """Length-bucketed train-step throughput on the same skewed-length
+    synthetic workload the fixed mode runs.
+
+    One AOT-compiled program per occupied bucket (node-budget batch
+    sizes), a deterministic bucket schedule weighted by the skewed length
+    distribution, and the same two-metric accounting: the padded metric
+    credits every *fed* node (bucket capacity), the real metric only
+    non-PAD nodes. Fixed-vs-bucketed on the same corpus distribution is
+    the honest padding-tax ratio."""
+    import jax
+    import numpy as np
+
+    from csat_tpu.configs import get_config
+    from csat_tpu.data.bucketing import assign_buckets, plan_buckets
+    from csat_tpu.data.toy import random_batch
+    from csat_tpu.train.loop import make_train_step
+    from csat_tpu.train.state import create_train_state, default_optimizer, make_model
+
+    overrides = dict(batch_size=batch_size, backend=backend,
+                     compute_dtype=dtype, prefetch=0, bucketing=True)
+    if backend == "pallas":
+        overrides["noise_mode"] = "counter"
+    cfg = get_config("python", **overrides)
+    src_v, tgt_v, trip_v = 10_000, 20_000, 1246
+    rng = np.random.default_rng(1)
+    specs = plan_buckets(cfg)
+
+    # bucket weights from a large skewed sample pool
+    pool = _skewed_lengths(rng, 4096, cfg.max_src_len)
+    assign = assign_buckets(
+        specs, pool, np.full(pool.shape, cfg.max_tgt_len - 1, np.int64))
+    counts = np.bincount(assign, minlength=len(specs)).astype(float)
+    # per-bucket share of the step budget ∝ batches needed to drain the
+    # pool through that bucket (samples / bucket batch size)
+    share = np.array(
+        [counts[k] / specs[k].batch_size for k in range(len(specs))])
+    share = share / share.sum()
+    steps_per_bucket = [int(round(n_steps * share[k]))
+                        for k in range(len(specs))]
+    if not any(steps_per_bucket):
+        # tiny user-supplied step budgets can round every share to zero —
+        # give the dominant bucket the whole budget instead of measuring
+        # nothing (and tripping over unbound warmup state below)
+        steps_per_bucket[int(np.argmax(share))] = n_steps
+
+    model = make_model(cfg, src_v, tgt_v, trip_v)
+    tx = default_optimizer(cfg)
+    step = make_train_step(model, tx, cfg)
+
+    t_compile = time.perf_counter()
+    state = None
+    programs, batches, sched = {}, {}, []
+    for k, spec in enumerate(specs):
+        steps_k = steps_per_bucket[k]
+        if steps_k <= 0:
+            continue
+        bcfg = cfg.replace(max_src_len=spec.n, max_tgt_len=spec.t)
+        b = random_batch(bcfg, spec.batch_size, src_v, tgt_v, trip_v, seed=k)
+        # real lengths drawn from the samples the planner actually ASSIGNS
+        # to this bucket (not clamped at capacity, which would concentrate
+        # mass at n and flatter the bucketed real-node metric)
+        members = pool[assign == k]
+        lens = members[np.random.default_rng(100 + k).integers(
+            0, len(members), spec.batch_size)]
+        b = _apply_lengths(b, lens)
+        b = jax.tree.map(jax.device_put, b)
+        if state is None:
+            state = create_train_state(model, tx, b, seed=cfg.seed)
+        programs[k] = step.lower(state, b).compile()
+        batches[k] = b
+        sched.extend([k] * steps_k)
+    # deterministic interleave, as the training iterator would produce
+    sched = [sched[p] for p in np.random.default_rng(7).permutation(len(sched))]
+    for k in programs:
+        state, metrics = programs[k](state, batches[k])  # warmup
+    loss = float(jax.block_until_ready(metrics["loss"]))
+    t_compile = time.perf_counter() - t_compile
+    if heartbeat is not None:
+        heartbeat({"phase": "compiled", "n_buckets": len(programs),
+                   "compile_s": round(t_compile, 1)})
+    if not np.isfinite(loss):
+        raise FloatingPointError(f"non-finite loss {loss}")
+
+    fed_nodes = real_nodes = 0
+    t0 = time.perf_counter()
+    for k in sched:
+        state, metrics = programs[k](state, batches[k])
+        fed_nodes += specs[k].batch_size * specs[k].n
+        real_nodes += int(np.sum(np.asarray(batches[k].num_node)))
+    loss = float(jax.block_until_ready(metrics["loss"]))
+    dt = time.perf_counter() - t0
+
+    n_chips = jax.device_count()
+    try:
+        peak = int((jax.devices()[0].memory_stats() or {})
+                   .get("peak_bytes_in_use", 0))
+    except Exception:
+        peak = 0
+    return {
+        "ok": True,
+        "backend": backend,
+        "dtype": dtype,
+        "mode": "bucketed",
+        "buckets": [
+            {"n": specs[k].n, "t": specs[k].t,
+             "batch_size": specs[k].batch_size,
+             "steps": int(sum(1 for s in sched if s == k))}
+            for k in sorted(programs)
+        ],
+        "noise_mode": cfg.noise_mode,
+        "device": jax.devices()[0].platform,
+        "n_chips": n_chips,
+        "loss": round(loss, 4),
+        "compile_s": round(t_compile, 1),
+        "steps": len(sched),
+        "step_ms": round(dt / max(len(sched), 1) * 1e3, 2),
+        "peak_hbm_gb": round(peak / 2**30, 3),
+        "nodes_per_sec_per_chip": fed_nodes / dt / n_chips,
+        "real_nodes_per_sec_per_chip": real_nodes / dt / n_chips,
     }
 
 
@@ -348,7 +519,7 @@ def main() -> None:
         for v in env.split(","):
             if v.count(":") == 1:
                 v += ":default:64:20"
-            if v.count(":") == 4:
+            if v.count(":") in (4, 5):  # optional 6th field: fixed|bucketed
                 specs.append(v)
             else:
                 notes.append(f"ignored malformed BENCH_VARIANTS entry {v!r}")
@@ -356,22 +527,27 @@ def main() -> None:
         # fastest-compile first (xla:f32), then the proven pallas f32 path,
         # then bf16 (never observed to finish a remote compile) — relay
         # windows have closed mid-first-compile (r4 window 1), so ordering
-        # by completion probability leaves the strongest number on disk
+        # by completion probability leaves the strongest number on disk.
+        # The bucketed variant rides last: its win is the real-node ratio,
+        # not the headline (vs_baseline semantics stay fixed-shape)
         specs = [
             "xla:float32:default:64:20",
             "pallas:float32:default:64:20",
             "xla:bfloat16:default:64:20",
             "pallas:bfloat16:default:64:20",
+            "xla:float32:default:64:20:bucketed",
         ]
     else:
         # honest CPU comparison: f32 at batch 6 — both frameworks' measured
         # best batch on this 1-core host (baseline_torch.json carries the
         # torch sweep), so vs_baseline is a same-batch best-vs-best ratio —
-        # plus bf16 and a small pallas-interpret correctness canary
+        # plus bf16, a small pallas-interpret correctness canary, and the
+        # length-bucketed mode (real-node throughput accounting)
         specs = [
             "xla:float32:cpu:6:4",
             "xla:bfloat16:cpu:6:4",
             "pallas:float32:cpu:2:1",
+            "xla:float32:cpu:6:4:bucketed",
         ]
 
     # -- phase 2: one serve child per platform group (one chip claim for all
@@ -466,9 +642,11 @@ def main() -> None:
             for cand in reversed(archived):
                 sess = [
                     {k: rec[k] for k in (
-                        "spec", "backend", "dtype", "noise_mode", "device",
-                        "step_ms", "peak_hbm_gb", "xla_temp_gb", "xla_arg_gb",
-                        "nodes_per_sec_per_chip", "compile_s") if k in rec}
+                        "spec", "backend", "dtype", "mode", "noise_mode",
+                        "device", "step_ms", "peak_hbm_gb", "xla_temp_gb",
+                        "xla_arg_gb", "nodes_per_sec_per_chip",
+                        "real_nodes_per_sec_per_chip", "compile_s")
+                     if k in rec}
                     for rec in _read_results(cand)[0]
                     if rec.get("device") != "cpu"
                 ]
@@ -508,8 +686,13 @@ def main() -> None:
         pass
 
     if results:
-        # canary runs (tiny pallas-interpret) are excluded from "best"
-        real = [r for r in results if not (r["device"] == "cpu" and r["backend"] == "pallas")]
+        # canary runs (tiny pallas-interpret) are excluded from "best";
+        # so are bucketed records — their fed-node metric is not the
+        # padded-credit protocol vs_baseline was calibrated on (they still
+        # appear in all_variants with the honest real-node numbers)
+        real = [r for r in results
+                if not (r["device"] == "cpu" and r["backend"] == "pallas")
+                and r.get("mode", "fixed") != "bucketed"]
         pool = real or results
         best = max(pool, key=lambda r: r["nodes_per_sec_per_chip"])
         value = best["nodes_per_sec_per_chip"]
@@ -532,6 +715,12 @@ def main() -> None:
             "dtype": best["dtype"],
             "device": best["device"],
             "step_ms": best["step_ms"],
+            # honest companion to the padded-credit headline: non-PAD
+            # nodes only (same skewed workload; see all_variants for the
+            # bucketed mode's numbers)
+            "real_nodes_per_sec_per_chip": round(
+                best["real_nodes_per_sec_per_chip"], 1)
+            if "real_nodes_per_sec_per_chip" in best else None,
             "baseline_device": baseline_device,
             "baseline_batch": baseline_batch,
             "tpu_probe": (
@@ -545,9 +734,11 @@ def main() -> None:
         if notes:
             out["notes"] = "; ".join(notes)
         def _variant_rec(r: dict) -> dict:
-            rec = {k: r[k] for k in ("backend", "dtype", "device", "step_ms",
-                                     "peak_hbm_gb", "xla_temp_gb",
-                                     "nodes_per_sec_per_chip")
+            rec = {k: r[k] for k in ("backend", "dtype", "mode", "device",
+                                     "step_ms", "peak_hbm_gb", "xla_temp_gb",
+                                     "nodes_per_sec_per_chip",
+                                     "real_nodes_per_sec_per_chip",
+                                     "buckets")
                    if k in r}
             # self-describing artifact (r4 verdict weak #6): pallas on CPU is
             # pl.pallas_call(interpret=True) — a correctness canary, not a

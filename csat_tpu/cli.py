@@ -45,6 +45,13 @@ def main() -> None:
                    help="malformed training batches to quarantine-and-skip "
                         "before failing loud; default keeps the config's "
                         "value")
+    p.add_argument("--bucketing", action="store_true",
+                   help="length-bucketed execution: collate each sample at "
+                        "the smallest fitting (N, T) bucket with node-budget "
+                        "batch sizes (csat_tpu/data/bucketing.py)")
+    p.add_argument("--bucket_src_lens", default="",
+                   help="comma list of bucket node capacities (default: "
+                        "geometric ladder capped by max_src_len)")
     args = p.parse_args()
 
     if args.platform:
@@ -77,6 +84,11 @@ def main() -> None:
         overrides["watchdog_timeout_s"] = args.watchdog_timeout_s
     if args.data_error_budget >= 0:
         overrides["data_error_budget"] = args.data_error_budget
+    if args.bucketing:
+        overrides["bucketing"] = True
+    if args.bucket_src_lens:
+        overrides["bucket_src_lens"] = tuple(
+            int(v) for v in args.bucket_src_lens.split(","))
     overrides["scalar_log"] = True  # the CLI always streams scalars.jsonl
     cfg = get_config(args.config, **overrides)
 

@@ -115,6 +115,35 @@ class Config:
     compute_dtype: str = "float32"  # "bfloat16" for MXU-friendly training
     mesh_shape: Tuple[Tuple[str, int], ...] = (("data", 1), ("model", 1))
     decode_with_cache: bool = True
+    # --- length-bucketed execution (csat_tpu/data/bucketing.py) ---
+    # Assign each sample to the smallest of a small (N, T) bucket grid and
+    # batch per bucket under a node budget, instead of padding everything
+    # to (max_src_len, max_tgt_len). Kills the O(N²) padding tax on the
+    # CSE/SBM hot path; bounded recompiles (one program per bucket shape,
+    # warmed eagerly by the Trainer; the persistent compilation cache
+    # amortizes them across runs).
+    bucketing: bool = False
+    # ascending node-capacity ladder; () = geometric halving down to 32
+    # capped by max_src_len (the flagship shape is always appended)
+    bucket_src_lens: Tuple[int, ...] = ()
+    # ascending NL-capacity ladder (max_tgt_len semantics); () = flagship only
+    bucket_tgt_lens: Tuple[int, ...] = ()
+    # per-batch node budget: bucket batch size = budget // n (smaller
+    # buckets get proportionally larger batches). 0 = batch_size·max_src_len
+    bucket_token_budget: int = 0
+    # eagerly AOT-compile the train step for every bucket shape at fit
+    # start (bounded, known set) instead of paying each compile mid-epoch
+    bucket_warm_compile: bool = True
+    # opt-in early-EOS decode exit (lax.while_loop): stops once every row
+    # has emitted </s>. OFF by default — the reference always runs the
+    # full max_tgt_len-1 steps, and although the metric transform
+    # truncates at the first </s> either way, the exact-parity A/B
+    # contract is the fixed-step scan (train/decode.py)
+    decode_early_eos: bool = False
+    # persistent XLA compilation cache for Trainer runs ("" = off; bench
+    # and the CLI already wire their own) — bucketing multiplies program
+    # count, the cache amortizes each bucket's compile across runs
+    compilation_cache_dir: str = ""
     # host-side input double-buffering depth (csat_tpu/train/loop.py:
     # prefetch_batches); 0 = synchronous
     prefetch: int = 2
@@ -133,6 +162,19 @@ class Config:
     #              gradient (training-dynamics parity mode).
     #   "zero"   — zero PAD lookups (the cleaner variant, r1-r4 behavior).
     pad_row: str = "zero"
+    # CSE relative-attention rows with NO related pair (raw L/T all zero —
+    # e.g. every T-head row of a node without siblings): the reference's
+    # -1e9 mask-fill makes softmax spread them UNIFORMLY over the padded
+    # width, so their output attends to PAD garbage and silently depends
+    # on max_src_len (measured: ~0.4 max |Δlog p| between N=32 and N=64
+    # padding of identical samples).
+    #   "uniform" — reference behavior (shape-dependent quirk; default).
+    #   "zero"    — flagged quirk-fix (SURVEY §8 policy): such rows take
+    #               nothing from attention (the residual carries the
+    #               token) — shape-invariant, which is what makes the
+    #               bucketed path bit-identical to the fixed path for
+    #               pegen configs (csat_tpu/data/bucketing.py).
+    cse_empty_rows: str = "uniform"
     # initialization scheme (csat_tpu/models/init.py):
     #   "flax"      — per-module xavier (r1-r4 behavior).
     #   "reference" — the reference's realized distributions: torch's
@@ -213,6 +255,7 @@ class Config:
         ), self.use_pegen
         assert self.backend in ("xla", "pallas"), self.backend
         assert self.pad_row in ("zero", "frozen"), self.pad_row
+        assert self.cse_empty_rows in ("uniform", "zero"), self.cse_empty_rows
         assert self.init_scheme in ("flax", "reference"), self.init_scheme
         assert self.eval_graph in ("sample", "expected"), self.eval_graph
         assert self.guard_rollback_after >= 0, self.guard_rollback_after
@@ -238,6 +281,26 @@ class Config:
                     "axis only (pallas/ring configs keep eval_graph="
                     "'sample')"
                 )
+        assert self.bucket_token_budget >= 0, self.bucket_token_budget
+        assert all(n >= 1 for n in self.bucket_src_lens), self.bucket_src_lens
+        assert all(t >= 2 for t in self.bucket_tgt_lens), (
+            f"bucket_tgt_lens {self.bucket_tgt_lens}: max_tgt_len semantics, "
+            "tgt_seq width is t-1 so every entry must be >= 2"
+        )
+        if self.bucketing:
+            if self.pipeline_stages > 1:
+                raise ValueError(
+                    "bucketing does not compose with pipeline_stages>1 (v1): "
+                    "microbatch divisibility is checked against the single "
+                    "fixed batch_size, and per-bucket batch sizes vary"
+                )
+            for name, size in self.mesh_shape:
+                if name == "seq" and size > 1:
+                    raise ValueError(
+                        "bucketing does not compose with a sharded 'seq' "
+                        "mesh axis (v1): bucket node counts need not divide "
+                        "the seq shard count"
+                    )
         assert self.noise_mode in ("shared", "counter"), self.noise_mode
         assert self.seq_impl in ("allgather", "ring"), self.seq_impl
         if (self.seq_impl == "ring" and self.noise_mode != "counter"
@@ -415,6 +478,9 @@ def config_from_dict(d: dict) -> Config:
     kw = {k: v for k, v in d.items() if k in known}
     if "clusters" in kw:
         kw["clusters"] = tuple(int(c) for c in kw["clusters"])
+    for lens in ("bucket_src_lens", "bucket_tgt_lens"):
+        if lens in kw:
+            kw[lens] = tuple(int(v) for v in kw[lens])
     if "mesh_shape" in kw:
         kw["mesh_shape"] = tuple((str(n), int(s)) for n, s in kw["mesh_shape"])
     cfg = Config(**kw)

@@ -8,3 +8,11 @@ from csat_tpu.data.ast_tools import (  # noqa: F401
 )
 from csat_tpu.data.vocab import Vocab, create_vocab, load_vocab  # noqa: F401
 from csat_tpu.data.dataset import ASTDataset, Batch, collate  # noqa: F401
+from csat_tpu.data.bucketing import (  # noqa: F401
+    BucketSpec,
+    bucket_histogram,
+    iterate_bucketed_batches,
+    pad_batch,
+    plan_buckets,
+    slice_batch,
+)

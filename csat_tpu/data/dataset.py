@@ -355,6 +355,11 @@ def iterate_batches(
     own slice — the JAX-native replacement for ``DistributedSampler``,
     ref ``script/train.py:135-142``).
 
+    Fixed-shape: every batch is padded to ``(max_src_len, max_tgt_len)``.
+    The length-bucketed sibling with the same contract (determinism,
+    lockstep sharding, resilience hooks) but per-bucket shapes is
+    :func:`csat_tpu.data.bucketing.iterate_bucketed_batches`.
+
     ``seed`` must be identical on every host (pass ``config.seed + epoch``):
     the permutation is derived from it deterministically so the shards form a
     partition. The index set is trimmed to a multiple of ``num_shards`` so

@@ -117,6 +117,19 @@ class DisentangledAttn(nn.Module):
             scores = disentangled_scores(q, k, rel_q, rel_k, rel8)
             attn = masked_softmax(scores, mask8)
             out = jnp.einsum("bhnm,bhmd->bhnd", attn, v)
+        if cfg.cse_empty_rows == "zero":
+            # flagged quirk-fix (configs.Config.cse_empty_rows): a row with
+            # no related pair — every column masked — softmaxes to uniform
+            # over the PADDED width under the reference's -1e9 fill, tying
+            # its output to max_src_len. Zeroing the row's attention output
+            # (the residual in CSELayer carries the token) is
+            # shape-invariant: the bucketed bit-identity contract.
+            # Post-attention row zeroing so both the XLA and the fused
+            # Pallas path get identical semantics. Reduce the two planes
+            # first, then fan out to heads — never materializes an
+            # O(B·H·N²) boolean.
+            empty = jnp.repeat(mask.all(axis=-1), half, axis=1)  # (B, H, N)
+            out = jnp.where(empty[..., None], 0.0, out)
         out = merge_heads(out).astype(self.dtype)
         return dense(d, self.dtype, name="wo")(out)
 

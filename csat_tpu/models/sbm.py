@@ -307,7 +307,11 @@ class SBMEncoder(nn.Module):
         cfg = self.cfg
         if cfg.use_pegen == "sequential":
             pe = None
-            x = src_emb + sinusoidal_table(cfg.max_src_len, cfg.sbm_enc_dim)[None].astype(self.dtype)
+            # sliced to the batch's node width so length-bucketed batches
+            # (N < max_src_len) reuse the identical leading table rows
+            x = src_emb + sinusoidal_table(cfg.max_src_len, cfg.sbm_enc_dim)[
+                None, : src_emb.shape[1]
+            ].astype(self.dtype)
         else:
             pe = dense(cfg.pe_dim, self.dtype, name="pe_expand")(src_pe)
             x = jnp.concatenate([src_emb, pe], axis=-1)

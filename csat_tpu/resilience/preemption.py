@@ -109,19 +109,31 @@ def snapshot_step(epoch: int, iterations_done: int) -> int:
     return int(epoch) * _STEP_STRIDE + int(iterations_done)
 
 
-def write_resume_marker(checkpoint_dir: str, epoch: int, iterations_done: int) -> str:
+def write_resume_marker(
+    checkpoint_dir: str, epoch: int, iterations_done: int,
+    plan: Optional[str] = None,
+) -> str:
     """Record that the preemption snapshot holds mid-epoch state: ``epoch``
     is the in-flight epoch and ``iterations_done`` how many of its
     iterations the saved state already contains. Written atomically
-    (rename) next to the snapshot."""
+    (rename) next to the snapshot.
+
+    ``plan`` identifies the deterministic per-host batch sequence the
+    iteration count addresses (the Trainer stamps
+    ``csat_tpu.data.bucketing.plan_signature`` plus the host count):
+    the resume path refuses a marker written under a different plan or
+    topology instead of silently replaying the wrong batches."""
     d = preempt_dir(checkpoint_dir)
     os.makedirs(d, exist_ok=True)
     path = os.path.join(d, _MARKER)
     tmp = path + ".tmp"
+    marker = {"epoch": int(epoch),
+              "iterations_done": int(iterations_done),
+              "step": snapshot_step(epoch, iterations_done)}
+    if plan is not None:
+        marker["plan"] = str(plan)
     with open(tmp, "w") as f:
-        json.dump({"epoch": int(epoch),
-                   "iterations_done": int(iterations_done),
-                   "step": snapshot_step(epoch, iterations_done)}, f)
+        json.dump(marker, f)
     os.replace(tmp, path)
     return path
 
@@ -129,10 +141,11 @@ def write_resume_marker(checkpoint_dir: str, epoch: int, iterations_done: int) -
 def read_resume_marker(checkpoint_dir: str) -> Optional[dict]:
     """The resume marker, validated against the snapshot actually on disk.
 
-    Returns ``{"epoch": int, "iterations_done": int, "step": int}`` only
-    when the preemption manager's latest step matches the marker — a stale
-    marker (snapshot GC'd, partial write, marker from an older run layout)
-    is ignored rather than trusted."""
+    Returns ``{"epoch": int, "iterations_done": int, "step": int}`` (plus
+    ``"plan"`` when the marker recorded one) only when the preemption
+    manager's latest step matches the marker — a stale marker (snapshot
+    GC'd, partial write, marker from an older run layout) is ignored
+    rather than trusted."""
     d = preempt_dir(checkpoint_dir)
     path = os.path.join(d, _MARKER)
     if not os.path.exists(path):
@@ -149,4 +162,7 @@ def read_resume_marker(checkpoint_dir: str) -> Optional[dict]:
 
     if latest_step(d) != step:
         return None
-    return {"epoch": epoch, "iterations_done": iterations, "step": step}
+    out = {"epoch": epoch, "iterations_done": iterations, "step": step}
+    if "plan" in marker:
+        out["plan"] = str(marker["plan"])
+    return out

@@ -1,5 +1,15 @@
-from csat_tpu.train.decode import greedy_decode, greedy_decode_nocache  # noqa: F401
-from csat_tpu.train.loop import Trainer, evaluate_bleu, make_train_step, run_test  # noqa: F401
+from csat_tpu.train.decode import (  # noqa: F401
+    greedy_decode,
+    greedy_decode_early_eos,
+    greedy_decode_nocache,
+)
+from csat_tpu.train.loop import (  # noqa: F401
+    ProgramCache,
+    Trainer,
+    evaluate_bleu,
+    make_train_step,
+    run_test,
+)
 from csat_tpu.train.loss import label_smoothing_loss  # noqa: F401
 from csat_tpu.train.optimizer import adamw  # noqa: F401
 from csat_tpu.train.state import TrainState, create_train_state, default_optimizer, make_model  # noqa: F401

@@ -15,6 +15,17 @@ Two implementations:
 * :func:`greedy_decode_nocache` — reference-compat A/B mode: re-runs the
   full teacher-forced forward on the growing (padded) prefix each step, as
   the torch code does. Output-identical; asymptotically slower.
+* :func:`greedy_decode_early_eos` — opt-in (``cfg.decode_early_eos``)
+  ``lax.while_loop`` variant that exits once every row has emitted
+  ``</s>``. Off by default to preserve reference parity: the emitted
+  prefix up to each row's first EOS is identical to :func:`greedy_decode`
+  (rows keep decoding until *all* are done, exactly as the fixed-step
+  scan would), only the all-done tail — which the metric transform
+  truncates anyway — is left as PAD instead of computed.
+
+All decoders take the step count from ``batch.tgt_seq``'s width, not the
+config, so length-bucketed batches (``csat_tpu/data/bucketing.py``) decode
+at their bucket's T capacity with the same compiled program per shape.
 """
 
 from __future__ import annotations
@@ -26,9 +37,9 @@ import jax.numpy as jnp
 
 from csat_tpu.data.dataset import Batch
 from csat_tpu.models import CSATrans
-from csat_tpu.utils import BOS, PAD
+from csat_tpu.utils import BOS, EOS, PAD
 
-__all__ = ["greedy_decode", "greedy_decode_nocache"]
+__all__ = ["greedy_decode", "greedy_decode_nocache", "greedy_decode_early_eos"]
 
 
 def greedy_decode(
@@ -37,9 +48,8 @@ def greedy_decode(
     batch: Batch,
     sample_key: jax.Array,
 ) -> jnp.ndarray:
-    """→ (B, max_tgt_len-1) generated token ids (BOS excluded)."""
-    cfg = model.cfg
-    steps = cfg.max_tgt_len - 1
+    """→ (B, T-1) generated token ids (BOS excluded), T from the batch."""
+    steps = batch.tgt_seq.shape[1]
     memory, _, _, _, _ = model.apply(
         variables, batch, method=CSATrans.encode, rngs={"sample": sample_key}
     )
@@ -87,8 +97,12 @@ def greedy_decode_nocache(
     PAD — for position i this is equivalent to the reference's length-(i+1)
     prefix rerun, because ``make_std_mask`` hides both pads and futures.
     """
-    cfg = model.cfg
-    steps = cfg.max_tgt_len - 1
+    steps = batch.tgt_seq.shape[1]
+    b = batch.src_seq.shape[0]
+    if steps <= 0:
+        # a T<=1 capacity decodes nothing — return the empty sequence
+        # instead of tripping over the unbound ``last`` below
+        return jnp.zeros((b, 0), dtype=jnp.int32)
 
     @jax.jit
     def forward(tgt_seq):
@@ -98,7 +112,6 @@ def greedy_decode_nocache(
         )
         return log_probs
 
-    b = batch.src_seq.shape[0]
     ys = jnp.full((b, steps), PAD, dtype=jnp.int32).at[:, 0].set(BOS)
     for i in range(steps):
         log_probs = forward(ys)
@@ -109,3 +122,57 @@ def greedy_decode_nocache(
             last = nxt
     out = jnp.concatenate([ys[:, 1:], last[:, None]], axis=1)
     return out
+
+
+def greedy_decode_early_eos(
+    model: CSATrans,
+    variables: Any,
+    batch: Batch,
+    sample_key: jax.Array,
+) -> jnp.ndarray:
+    """Early-exit greedy decode (``cfg.decode_early_eos`` opt-in).
+
+    Identical per-step math to :func:`greedy_decode` (same cache, same
+    pad-masking of generated PADs), but driven by ``lax.while_loop`` with
+    the stop condition "every row has emitted EOS" — decode cost becomes
+    proportional to the *longest real summary in the batch* instead of
+    the bucket capacity. Positions after the early exit stay PAD; each
+    row's prefix up to and including its first EOS is bit-identical to
+    the fixed-step scan, which is why the BLEU/ROUGE transforms (which
+    truncate at the first EOS) see no difference.
+    """
+    steps = batch.tgt_seq.shape[1]
+    memory, _, _, _, _ = model.apply(
+        variables, batch, method=CSATrans.encode, rngs={"sample": sample_key}
+    )
+    src_mask = batch.src_seq == PAD
+    b = memory.shape[0]
+    cache0 = model.apply(variables, memory, steps, method=CSATrans.init_decode_cache)
+    prev_pad0 = jnp.zeros((b, steps), dtype=bool)
+    tok0 = jnp.full((b, 1), BOS, dtype=jnp.int32)
+    toks0 = jnp.full((b, steps), PAD, dtype=jnp.int32)
+    done0 = jnp.zeros((b,), dtype=bool)
+
+    def cond(carry):
+        i, _, _, _, _, done = carry
+        return (i < steps) & ~jnp.all(done)
+
+    def body(carry):
+        i, tok, prev_pad, cache, toks, done = carry
+        log_probs, cache = model.apply(
+            variables, tok, i, cache, memory, src_mask, prev_pad,
+            method=CSATrans.decode_step,
+        )
+        nxt = jnp.argmax(log_probs, axis=-1).astype(jnp.int32)  # (B,)
+        toks = jax.lax.dynamic_update_slice_in_dim(toks, nxt[:, None], i, axis=1)
+        prev_pad = jax.lax.cond(
+            i + 1 < steps,
+            lambda pp: pp.at[:, i + 1].set(nxt == PAD),
+            lambda pp: pp,
+            prev_pad,
+        )
+        return (i + 1, nxt[:, None], prev_pad, cache, toks, done | (nxt == EOS))
+
+    carry = (jnp.asarray(0, jnp.int32), tok0, prev_pad0, cache0, toks0, done0)
+    _, _, _, _, toks, _ = jax.lax.while_loop(cond, body, carry)
+    return toks

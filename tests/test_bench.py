@@ -26,12 +26,15 @@ def bench(tmp_path, monkeypatch):
 
 
 def _result(spec, nodes):
-    backend, dtype, platform, _, steps = spec.split(":")
+    parts = spec.split(":")
+    backend, dtype, platform, _, steps = parts[:5]
     return {
         "ok": True, "backend": backend, "dtype": dtype,
+        "mode": parts[5] if len(parts) > 5 else "fixed",
         "device": "tpu" if platform == "default" else "cpu",
         "n_chips": 1, "loss": 1.0, "compile_s": 10.0, "steps": int(steps),
-        "step_ms": 1.0, "nodes_per_sec_per_chip": nodes, "spec": spec,
+        "step_ms": 1.0, "nodes_per_sec_per_chip": nodes,
+        "real_nodes_per_sec_per_chip": nodes * 0.4, "spec": spec,
     }
 
 
@@ -63,9 +66,12 @@ def test_alive_tpu_best_variant_wins(bench, monkeypatch, capsys):
     monkeypatch.setattr(bench, "_run_child", fake_child)
     out = _run_main(bench, capsys)
     assert out["device"] == "tpu"
-    assert out["value"] == 103.0  # the 4th (best) variant
+    # the 4th variant wins: the 5th (bucketed, 104) is excluded from the
+    # headline pool — vs_baseline stays defined on the padded-credit
+    # fixed-shape protocol
+    assert out["value"] == 103.0
     assert "degraded" not in out
-    assert len(out["all_variants"]) == 4
+    assert len(out["all_variants"]) == 5
     # one probe + ONE serve for the whole device group (single claim)
     assert [c[0] for c in calls] == ["--probe", "--serve"]
 
@@ -117,7 +123,7 @@ def test_killed_serve_retries_untried_first(bench, monkeypatch, capsys):
     monkeypatch.setattr(bench, "_run_child", fake_child)
     out = _run_main(bench, capsys)
     assert state["round"] == 2
-    assert len(out["all_variants"]) == 4
+    assert len(out["all_variants"]) == 5
     assert out["value"] == 300.0
     assert "killed during" not in out.get("notes", "")  # retried successfully
 
@@ -143,7 +149,7 @@ def test_deterministic_error_not_retried(bench, monkeypatch, capsys):
     out = _run_main(bench, capsys)
     assert state["serves"] == 1  # error is final: no retry round
     assert "non-finite" in out["notes"]
-    assert len(out["all_variants"]) == 3
+    assert len(out["all_variants"]) == 4
 
 
 def test_malformed_bench_variants_flagged(bench, monkeypatch, capsys):
@@ -185,7 +191,7 @@ def test_done_record_authoritative_over_stdout_marker(bench, monkeypatch, capsys
     out = _run_main(bench, capsys)
     assert state["serves"] == 1  # done record suppressed the retry round
     assert "serve:" not in out.get("notes", "")
-    assert len(out["all_variants"]) == 4
+    assert len(out["all_variants"]) == 5
     assert "degraded" not in out
 
 
@@ -220,8 +226,10 @@ def test_dead_probe_embeds_archived_tpu_session(bench, monkeypatch, tmp_path, ca
     sess = out["tpu_session"]
     assert "20260731" in sess["source"]  # newest file wins
     assert sess["results"] == [{k: newer[k] for k in (
-        "spec", "backend", "dtype", "device", "step_ms", "peak_hbm_gb",
-        "nodes_per_sec_per_chip", "compile_s") if k in newer}]  # cpu rec dropped
+        "spec", "backend", "dtype", "mode", "device", "step_ms",
+        "peak_hbm_gb", "nodes_per_sec_per_chip",
+        "real_nodes_per_sec_per_chip", "compile_s")
+        if k in newer}]  # cpu rec dropped
     assert "NOT measured by this invocation" in sess["note"]
 
 

@@ -1,0 +1,88 @@
+"""One-shot padding-tax report for a corpus.
+
+Prints, as one JSON document, the length-bucket histogram and the
+padded-vs-real node accounting (`csat_tpu.data.bucketing.bucket_histogram`)
+for a processed split: how many of the nodes the fixed-shape pipeline
+feeds are real vs PAD, what the configured bucket plan would feed
+instead, and the projected shrink of the O(N²) relation-matrix bytes —
+the numbers that justify (or size) a ``bucketing=True`` config before
+committing to its compile set.
+
+Usage::
+
+    python tools/padding_stats.py --config python --split train
+    python tools/padding_stats.py --config python --src-lens 37,75,150
+    python tools/padding_stats.py --synthetic 256   # no corpus needed
+
+``--synthetic N`` generates the test-suite's synthetic corpus (N train
+samples) into a temp dir, so the tool runs anywhere.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import tempfile
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser(description=__doc__.split("\n\n")[0])
+    ap.add_argument("--config", default="python")
+    ap.add_argument("--split", default="train")
+    ap.add_argument("--data-dir", default="", help="override cfg.data_dir")
+    ap.add_argument("--max-src-len", type=int, default=0)
+    ap.add_argument("--src-lens", default="",
+                    help="comma list overriding bucket_src_lens")
+    ap.add_argument("--tgt-lens", default="",
+                    help="comma list overriding bucket_tgt_lens")
+    ap.add_argument("--budget", type=int, default=0,
+                    help="bucket_token_budget override")
+    ap.add_argument("--synthetic", type=int, default=0,
+                    help="generate an N-sample synthetic corpus instead of "
+                         "reading cfg.data_dir")
+    args = ap.parse_args()
+
+    from csat_tpu.configs import get_config
+    from csat_tpu.data.bucketing import bucket_histogram
+    from csat_tpu.data.dataset import ASTDataset
+    from csat_tpu.data.vocab import load_vocab
+
+    overrides: dict = {"bucketing": True}
+    if args.max_src_len:
+        overrides["max_src_len"] = args.max_src_len
+    if args.src_lens:
+        overrides["bucket_src_lens"] = tuple(
+            int(v) for v in args.src_lens.split(","))
+    if args.tgt_lens:
+        overrides["bucket_tgt_lens"] = tuple(
+            int(v) for v in args.tgt_lens.split(","))
+    if args.budget:
+        overrides["bucket_token_budget"] = args.budget
+
+    if args.synthetic:
+        from csat_tpu.data.synthetic import make_corpus
+
+        data_dir = tempfile.mkdtemp(prefix="padding_stats_")
+        make_corpus(data_dir, n_train=args.synthetic,
+                    n_dev=max(args.synthetic // 4, 1),
+                    n_test=max(args.synthetic // 4, 1), seed=0)
+        overrides["data_dir"] = data_dir
+    elif args.data_dir:
+        overrides["data_dir"] = args.data_dir
+
+    cfg = get_config(args.config, **overrides)
+    src_vocab, tgt_vocab = load_vocab(cfg.data_dir)
+    ds = ASTDataset(cfg, args.split, src_vocab, tgt_vocab)
+    report = bucket_histogram(cfg, ds.arrays)
+    report["config"] = args.config
+    report["split"] = args.split
+    report["data_dir"] = cfg.data_dir
+    print(json.dumps(report, indent=2))
+
+
+if __name__ == "__main__":
+    main()

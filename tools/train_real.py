@@ -73,6 +73,9 @@ def main() -> None:
                         "pegen = width, pe = width//2, ff = 4*width) — 64 "
                         "pairs with tools/train_torch_real.py --width 64 "
                         "on the scaled corpus")
+    p.add_argument("--bucketing", action="store_true",
+                   help="length-bucketed execution (csat_tpu/data/bucketing."
+                        "py): per-bucket shapes + node-budget batch sizes")
     p.add_argument("--init_scheme", default="", choices=["", "flax", "reference"],
                    help="native init distributions (configs.Config."
                         "init_scheme; 'reference' = packed-fan decoder "
@@ -127,6 +130,8 @@ def main() -> None:
         dims["pad_row"] = args.pad_row
     if args.init_scheme:
         dims["init_scheme"] = args.init_scheme
+    if args.bucketing:
+        dims["bucketing"] = True
     tag = f"_{args.tag}" if args.tag else ""
     cfg = get_config(
         name,
