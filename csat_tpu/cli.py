@@ -9,17 +9,34 @@ Usage::
 
     python -m csat_tpu.cli --config python --data_dir ./processed/tree_sitter_python
     python -m csat_tpu.cli --config python_full_att --epochs 20 --is_test ...
+
+Serving subcommands (continuous-batching inference, ``csat_tpu/serve/``)::
+
+    python -m csat_tpu.cli summarize --config python --data_dir ... file.py
+    python -m csat_tpu.cli serve --config python --data_dir ... < reqs.jsonl
 """
 
 from __future__ import annotations
 
 import argparse
 import json
+import sys
 
 import jax
 
 
 def main() -> None:
+    # subcommand dispatch: `serve` / `summarize` go to the inference CLI
+    # (csat_tpu/serve/cli.py); everything else is the legacy train/test path
+    if len(sys.argv) > 1 and sys.argv[1] in ("serve", "summarize"):
+        from csat_tpu.serve.cli import main as serve_main
+
+        serve_main(sys.argv[1:])
+        return
+    _train_main()
+
+
+def _train_main() -> None:
     p = argparse.ArgumentParser(description=__doc__)
     p.add_argument("--config", required=True, help="named variant, e.g. python, java_full_att")
     p.add_argument("--data_dir", default="", help="override the config's data_dir")

@@ -144,6 +144,19 @@ class Config:
     # and the CLI already wire their own) — bucketing multiplies program
     # count, the cache amortizes each bucket's compile across runs
     compilation_cache_dir: str = ""
+    # --- continuous-batching inference engine (csat_tpu/serve/) ---
+    # decode-slot pool size: the engine pre-allocates per-layer KV cache +
+    # encoder-memory regions for this many in-flight requests and advances
+    # all of them with ONE compiled decode-step program; rows retire at
+    # EOS (or their token budget) and freed slots refill from the queue
+    serve_slots: int = 8
+    # per-prefill-call node budget: each occupied prefill bucket n admits
+    # min(serve_slots, max(1, budget // n)) requests per compiled encoder
+    # call (short groups are row-padded, so steady state stays at one
+    # program per bucket). 0 = max(1, serve_slots // 2) · max_src_len —
+    # flagship-length prefills land in half-pool batches, short ones in
+    # proportionally larger batches up to the pool size
+    serve_prefill_budget: int = 0
     # host-side input double-buffering depth (csat_tpu/train/loop.py:
     # prefetch_batches); 0 = synchronous
     prefetch: int = 2
@@ -281,6 +294,8 @@ class Config:
                     "axis only (pallas/ring configs keep eval_graph="
                     "'sample')"
                 )
+        assert self.serve_slots >= 1, self.serve_slots
+        assert self.serve_prefill_budget >= 0, self.serve_prefill_budget
         assert self.bucket_token_budget >= 0, self.bucket_token_budget
         assert all(n >= 1 for n in self.bucket_src_lens), self.bucket_src_lens
         assert all(t >= 2 for t in self.bucket_tgt_lens), (

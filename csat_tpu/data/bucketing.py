@@ -65,6 +65,7 @@ __all__ = [
     "BucketSpec",
     "plan_buckets",
     "plan_signature",
+    "src_bucket_ladder",
     "sample_lengths",
     "assign_buckets",
     "bucket_views",
@@ -94,6 +95,17 @@ def _default_src_ladder(max_src_len: int, min_len: int = 32) -> Tuple[int, ...]:
     return tuple(sorted(out))
 
 
+def src_bucket_ladder(cfg: Config) -> Tuple[int, ...]:
+    """Ascending node-capacity ladder for a config — ``bucket_src_lens``
+    capped by the flagship N (always appended), or the default geometric
+    halving ladder.  Shared by the training bucket grid below and by the
+    serving engine's prefill shapes (``csat_tpu/serve/prefill.py``), so a
+    trained run and its serving deployment compile the same encoder
+    geometries and the persistent compilation cache carries over."""
+    src_lens = tuple(cfg.bucket_src_lens) or _default_src_ladder(cfg.max_src_len)
+    return tuple(sorted({min(n, cfg.max_src_len) for n in src_lens} | {cfg.max_src_len}))
+
+
 def plan_buckets(cfg: Config) -> Tuple[BucketSpec, ...]:
     """The bucket grid for a config, sorted ascending by ``(n, t)``.
 
@@ -103,9 +115,8 @@ def plan_buckets(cfg: Config) -> Tuple[BucketSpec, ...]:
     and never drop below 1; the flagship bucket under the default budget
     reproduces ``cfg.batch_size`` exactly.
     """
-    src_lens = tuple(cfg.bucket_src_lens) or _default_src_ladder(cfg.max_src_len)
+    src_lens = src_bucket_ladder(cfg)
     tgt_lens = tuple(cfg.bucket_tgt_lens) or (cfg.max_tgt_len,)
-    src_lens = tuple(sorted({min(n, cfg.max_src_len) for n in src_lens} | {cfg.max_src_len}))
     tgt_lens = tuple(sorted({min(t, cfg.max_tgt_len) for t in tgt_lens} | {cfg.max_tgt_len}))
     assert all(t >= 2 for t in tgt_lens), tgt_lens  # tgt_seq width t-1 >= 1
     assert all(n >= 1 for n in src_lens), src_lens

@@ -13,7 +13,45 @@ import numpy as np
 from csat_tpu.configs import Config
 from csat_tpu.data.dataset import Batch
 
-__all__ = ["random_batch"]
+__all__ = ["random_batch", "random_request_sample"]
+
+
+def random_request_sample(
+    cfg: Config,
+    src_vocab_size: int,
+    triplet_vocab_size: int,
+    n_real: int,
+    seed: int = 0,
+) -> dict:
+    """One *raw* (pre-collate) sample dict at the flagship width — the
+    request payload the serving engine ingests (``csat_tpu/serve``): raw
+    signed L/T distances (the collate derives masks/offsets/adjacency),
+    PAD beyond ``n_real`` real nodes."""
+    rng = np.random.default_rng(seed)
+    n = cfg.max_src_len
+    n_real = int(min(max(n_real, 1), n))
+    src = np.zeros((n,), np.int32)
+    src[:n_real] = rng.integers(4, src_vocab_size, (n_real,))
+    raw_l = np.zeros((n, n), np.int16)
+    raw_t = np.zeros((n, n), np.int16)
+    l_real = rng.integers(-6, 7, (n_real, n_real))
+    t_real = rng.integers(-4, 5, (n_real, n_real))
+    for m, real in ((raw_l, l_real), (raw_t, t_real)):
+        upper = np.triu(real, k=1)
+        m[:n_real, :n_real] = (upper - upper.T).astype(np.int16)
+    tp_dim = cfg.tree_pos_width * cfg.tree_pos_height
+    tree_pos = np.zeros((n, tp_dim), np.uint8)
+    tree_pos[:n_real] = (rng.random((n_real, tp_dim)) < 0.1).astype(np.uint8)
+    triplet = np.zeros((n,), np.int32)
+    triplet[:n_real] = rng.integers(1, triplet_vocab_size, (n_real,))
+    return {
+        "src_seq": src,
+        "L_raw": raw_l,
+        "T_raw": raw_t,
+        "num_node": np.asarray(n_real, np.int32),
+        "tree_pos": tree_pos,
+        "triplet": triplet,
+    }
 
 
 def random_batch(

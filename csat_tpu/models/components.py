@@ -41,10 +41,24 @@ def dense(features: int, dtype: Dtype = jnp.float32, name: Optional[str] = None)
 
 def sinusoidal_table(max_len: int, dim: int) -> jnp.ndarray:
     """(max_len, dim) sin/cos table (ref ``PositionalEncoding``, ``components.py:46-60``)."""
-    position = jnp.arange(max_len, dtype=jnp.float32)[:, None]
+    return sinusoidal_rows(jnp.arange(max_len), dim)
+
+
+def sinusoidal_rows(pos: jnp.ndarray, dim: int) -> jnp.ndarray:
+    """Rows ``pos`` of the sin/cos table, computed directly: ``(|pos|, dim)``.
+
+    Bit-identical to ``sinusoidal_table(max_len, dim)[pos]`` (same fp32
+    angle products through the same sin/cos), without materializing the
+    ``max_len`` table.  The lockstep scan decoder hoists the full table as
+    a loop invariant so it costs one computation per decode; a per-step
+    *program* (the serving engine's) has no loop to hoist out of and would
+    recompute all ``max_len·dim`` transcendentals every token — measured
+    2.6x the whole decode step on CPU — where its slots only need ``S``
+    rows."""
+    position = pos.astype(jnp.float32)[:, None]
     div = jnp.exp(jnp.arange(0, dim, 2, dtype=jnp.float32) * -(math.log(10000.0) / dim))
     ang = position * div
-    pe = jnp.zeros((max_len, dim), dtype=jnp.float32)
+    pe = jnp.zeros((pos.shape[0], dim), dtype=jnp.float32)
     pe = pe.at[:, 0::2].set(jnp.sin(ang))
     pe = pe.at[:, 1::2].set(jnp.cos(ang[:, : (dim + 1) // 2]))
     return pe
@@ -85,8 +99,11 @@ class Embeddings(nn.Module):
     def __call__(
         self, x: jnp.ndarray, deterministic: bool = True, pos: Optional[jnp.ndarray] = None
     ) -> jnp.ndarray:
-        """``pos`` (scalar) offsets the sinusoidal slice — used when embedding
-        a single token mid-sequence during cached decoding."""
+        """``pos`` offsets the sinusoidal slice — used when embedding a
+        single token mid-sequence during cached decoding. A scalar shifts
+        the whole batch (lockstep ``lax.scan`` decode); a ``(B,)`` vector
+        gives every row its own position (slot-pooled continuous batching,
+        ``csat_tpu/serve`` — each slot is mid-way through its own request)."""
         table = self.param("embedding", XAVIER, (self.vocab_size, self.hidden_size))
         emb = jnp.take(table, x, axis=0)
         if self.pad_row == "frozen":
@@ -101,11 +118,15 @@ class Embeddings(nn.Module):
         else:
             emb = jnp.where((x == PAD)[..., None], 0.0, emb)
         if self.with_pos:
-            pe = sinusoidal_table(self.max_len, self.hidden_size)
             if pos is None:
+                pe = sinusoidal_table(self.max_len, self.hidden_size)
                 emb = emb + pe[None, : x.shape[-1]]
-            else:
+            elif jnp.ndim(pos) == 0:
+                pe = sinusoidal_table(self.max_len, self.hidden_size)
                 emb = emb + jax.lax.dynamic_slice_in_dim(pe, pos, x.shape[-1], axis=0)[None]
+            else:
+                # per-row positions: x is (B, 1), one computed row per slot
+                emb = emb + sinusoidal_rows(pos, self.hidden_size)[:, None, :]
         emb = nn.LayerNorm(epsilon=LN_EPS, dtype=self.dtype)(emb)
         emb = nn.Dropout(self.dropout)(emb, deterministic=deterministic)
         return emb.astype(self.dtype)
@@ -192,12 +213,24 @@ class MultiHeadAttention(nn.Module):
             v = split_heads(self.v_proj(kv_in), self.num_heads)
 
         if cache is not None:
-            # cache: {"k": (B,H,T,dh), "v": (B,H,T,dh), "idx": ()} — write the
-            # new entries at position idx, then attend over the whole buffer
-            # with positions > idx masked by the caller-supplied mask.
+            # cache: {"k": (B,H,T,dh), "v": (B,H,T,dh), "idx": () | (B,)} —
+            # write the new entries at position idx, then attend over the
+            # whole buffer with positions > idx masked by the caller-supplied
+            # mask. A scalar idx is the lockstep lax.scan decode; a (B,)
+            # vector is the slot-pooled engine (csat_tpu/serve), where every
+            # slot sits at its own position — the write becomes a per-row
+            # one-hot select along the time axis (same stored values, same
+            # O(B·H·T·dh) cost as the attention itself).
             idx = cache["idx"]
-            k = jax.lax.dynamic_update_slice_in_dim(cache["k"], k, idx, axis=2)
-            v = jax.lax.dynamic_update_slice_in_dim(cache["v"], v, idx, axis=2)
+            if jnp.ndim(idx) == 0:
+                k = jax.lax.dynamic_update_slice_in_dim(cache["k"], k, idx, axis=2)
+                v = jax.lax.dynamic_update_slice_in_dim(cache["v"], v, idx, axis=2)
+            else:
+                tcap = cache["k"].shape[2]
+                hot = (jnp.arange(tcap)[None, :] == idx[:, None])  # (B, T)
+                sel = hot[:, None, :, None]  # broadcast over heads / head_dim
+                k = jnp.where(sel, k, cache["k"])
+                v = jnp.where(sel, v, cache["v"])
             cache = {"k": k, "v": v, "idx": idx + q_in.shape[1]}
 
         scores = jnp.einsum("bhqd,bhkd->bhqk", q.astype(jnp.float32), k.astype(jnp.float32))

@@ -184,9 +184,9 @@ class CSATrans(nn.Module):
     def decode_step(
         self,
         tok: jnp.ndarray,  # (B, 1) current input token
-        pos: jnp.ndarray,  # () int32 — its position
+        pos: jnp.ndarray,  # () int32 — its position; or (B,) per-slot positions
         cache: Dict[str, Any],
-        memory: jnp.ndarray,
+        memory: jnp.ndarray,  # unused when cache carries cross K/V (may be None)
         src_mask: jnp.ndarray,  # (B, N) bool
         prev_pad: jnp.ndarray,  # (B, max_len) bool — pad flags of tokens so far
     ) -> Tuple[jnp.ndarray, Dict[str, Any]]:
@@ -195,13 +195,63 @@ class CSATrans(nn.Module):
         ``prev_pad`` reproduces the reference's ``make_std_mask(ys, 0)``
         semantics exactly: a previously *generated* PAD token is masked out of
         later self-attention (``base_seq2seq.py:137``).
+
+        A scalar ``pos`` is the lockstep ``lax.scan`` decode (every row at
+        the same position). A ``(B,)`` vector is the slot-pooled continuous
+        batching path (``csat_tpu/serve``): each row embeds, masks and
+        cache-writes at its own position, so rows at different depths of
+        different requests advance in one compiled program. The per-row math
+        is identical — the vector form with equal entries reproduces the
+        scalar form bit-exactly.
         """
         max_len = prev_pad.shape[1]
         emb = self.tgt_embedding(tok, deterministic=True, pos=pos)
-        future = jnp.arange(max_len)[None, None, :] > pos  # (1, 1, max_len)
+        if jnp.ndim(pos) == 0:
+            future = jnp.arange(max_len)[None, None, :] > pos  # (1, 1, max_len)
+        else:
+            future = jnp.arange(max_len)[None, None, :] > pos[:, None, None]
         step_mask = prev_pad[:, None, :] | future  # (B, 1, max_len)
         dec_out, cache = self.decoder(
             emb, memory, step_mask, src_mask, deterministic=True, cache=cache
         )
         log_probs = self.generator(dec_out[:, -1], deterministic=True)
         return log_probs, cache
+
+    # ---------------- slot-pooled serving (csat_tpu/serve) ----------------
+
+    def project_cross_kv(self, memory: jnp.ndarray) -> Dict[str, Any]:
+        """Per-layer cross-attention K/V projected from encoder memory —
+        the piece of :meth:`init_decode_cache` the serving engine computes
+        at *prefill* time (bucketed shapes) and scatters into slot rows of
+        its pre-allocated pool, instead of re-deriving per decode."""
+        return {
+            f"layer_{i}": layer.cross_attn.project_kv(memory)
+            for i, layer in enumerate(self.decoder.layers)
+        }
+
+    def init_slot_cache(self, num_slots: int, max_len: int, mem_len: int) -> Dict[str, Any]:
+        """Zeroed per-layer K/V buffers for a pool of ``num_slots`` decode
+        slots: self-attn ``(S, H, max_len, dh)`` and cross-attn
+        ``(S, H, mem_len, dh)`` per layer. Unlike :meth:`init_decode_cache`
+        there is no shared ``idx`` — the engine threads per-slot positions
+        as the cache's ``(S,)`` idx vector each step — and cross K/V starts
+        empty: prefill writes each admitted request's projection into its
+        slot row."""
+        cfg = self.cfg
+        dh = cfg.hidden_size // cfg.num_heads
+
+        # fresh arrays per leaf: the pool is DONATED through the serving
+        # programs, and XLA rejects the same buffer donated twice
+        def zeros_self():
+            return jnp.zeros((num_slots, cfg.num_heads, max_len, dh), dtype=self.dtype)
+
+        def zeros_cross():
+            return jnp.zeros((num_slots, cfg.num_heads, mem_len, dh), dtype=self.dtype)
+
+        return {
+            f"layer_{i}": {
+                "self": {"k": zeros_self(), "v": zeros_self()},
+                "cross": {"k": zeros_cross(), "v": zeros_cross()},
+            }
+            for i in range(len(self.decoder.layers))
+        }

@@ -1,0 +1,19 @@
+"""Continuous-batching inference engine (slot-pooled KV cache, bucketed
+prefill, single compiled decode-step program).
+
+Entry points: :class:`ServeEngine` (submit/poll/tick/drain),
+``csat_tpu serve`` / ``csat_tpu summarize`` (serve/cli.py), and
+``bench.py``'s ``:serve`` mode.
+"""
+
+from csat_tpu.serve.engine import Request, ServeEngine  # noqa: F401
+from csat_tpu.serve.ingest import sample_from_dataset, sample_from_source  # noqa: F401
+from csat_tpu.serve.prefill import (  # noqa: F401
+    PrefillSpec,
+    assign_prefill_bucket,
+    build_prefill,
+    collate_requests,
+    prefill_plan,
+)
+from csat_tpu.serve.slots import SlotPool, build_decode_step, init_pool  # noqa: F401
+from csat_tpu.serve.stats import ServeStats, percentile  # noqa: F401

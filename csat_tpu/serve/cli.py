@@ -1,0 +1,212 @@
+"""``csat_tpu serve`` / ``csat_tpu summarize`` — code in, summaries out.
+
+Both subcommands build the same stack: named config + trained params →
+vocabs → :class:`~csat_tpu.serve.engine.ServeEngine`; raw snippets go
+through the L0/L1 extraction pipeline per request
+(``serve/ingest.py:sample_from_source``).
+
+* ``summarize`` — one-shot batch mode: read code snippets (files given as
+  arguments, or one snippet per ``--sep``-delimited block on stdin),
+  submit them all, drain, print one JSON line per snippet with the
+  detokenized summary, then an engine-stats line to stderr.
+* ``serve`` — long-running JSONL loop: each stdin line is a request
+  ``{"id": ..., "code": ...}`` (or a bare string); responses stream out
+  as JSON lines as they finish, interleaved with admission — the
+  continuous-batching path exercised end to end.  EOF drains and exits.
+
+Examples::
+
+    python -m csat_tpu.cli summarize --config python --data_dir ./processed \\
+        --checkpoint_dir ./outputs/... snippet1.py snippet2.py
+    cat requests.jsonl | python -m csat_tpu.cli serve --config python ...
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from typing import List, Optional
+
+__all__ = ["main", "build_engine"]
+
+
+def _parser() -> argparse.ArgumentParser:
+    # the subcommand itself is stripped by main() before parsing: argparse
+    # cannot reliably split two positional groups (command + files) around
+    # interleaved optionals
+    p = argparse.ArgumentParser(prog="csat_tpu serve|summarize", description=__doc__)
+    p.add_argument("--config", required=True, help="named variant, e.g. python")
+    p.add_argument("--data_dir", default="", help="override the config's data_dir (vocabs)")
+    p.add_argument("--checkpoint_dir", default="",
+                   help="orbax params dir (default: the config's output dir)")
+    p.add_argument("--serve_slots", type=int, default=0,
+                   help="decode-slot pool size (default: config serve_slots)")
+    p.add_argument("--max_new_tokens", type=int, default=0,
+                   help="per-request decode budget (0 = max_tgt_len - 1)")
+    p.add_argument("--platform", default="", help="force jax platform (cpu/tpu)")
+    p.add_argument("--sep", default="\x00",
+                   help="summarize stdin snippet separator (default NUL)")
+    p.add_argument("files", nargs="*", help="summarize: files holding one snippet each")
+    return p
+
+
+def build_engine(args):
+    """Config/vocab/params/engine bring-up shared by both subcommands."""
+    import jax
+
+    if args.platform:
+        jax.config.update("jax_platforms", args.platform)
+    from csat_tpu.utils.cache import enable_compilation_cache
+
+    enable_compilation_cache()
+
+    import os
+
+    from csat_tpu.configs import get_config, list_configs
+    from csat_tpu.data.vocab import Vocab, load_vocab
+    from csat_tpu.serve.engine import ServeEngine
+    from csat_tpu.train.checkpoint import restore_params
+    from csat_tpu.train.state import make_model
+
+    if args.config not in list_configs():
+        raise SystemExit(f"unknown config {args.config!r}; choose from {list_configs()}")
+    overrides = {}
+    if args.data_dir:
+        overrides["data_dir"] = args.data_dir
+    if args.serve_slots:
+        overrides["serve_slots"] = args.serve_slots
+    cfg = get_config(args.config, **overrides)
+
+    src_vocab, tgt_vocab = load_vocab(cfg.data_dir)
+    trip_path = os.path.join(cfg.data_dir, f"node_triplet_dictionary_{cfg.lang}.pt")
+    trip_vocab = (
+        Vocab(need_bos=False, file_path=trip_path).load()
+        if os.path.exists(trip_path) else None
+    )
+    model = make_model(cfg, src_vocab.size(), tgt_vocab.size(),
+                       trip_vocab.size() if trip_vocab else 0)
+    ckpt = args.checkpoint_dir or os.path.join(
+        cfg.output_dir, cfg.project_name, cfg.task_name)
+    params = restore_params(ckpt)
+    engine = ServeEngine(model, params, cfg, tgt_vocab=tgt_vocab)
+    return engine, cfg, src_vocab, trip_vocab
+
+
+def _ingest(engine, cfg, src_vocab, trip_vocab, code: str,
+            max_new_tokens: int) -> Optional[int]:
+    from csat_tpu.serve.ingest import sample_from_source
+
+    sample = sample_from_source(code, cfg, src_vocab, trip_vocab)
+    return engine.submit(sample, max_new_tokens=max_new_tokens)
+
+
+def _summarize(args) -> None:
+    engine, cfg, src_vocab, trip_vocab = build_engine(args)
+    if args.files:
+        snippets = [open(f, encoding="utf-8").read() for f in args.files]
+        names: List[str] = list(args.files)
+    else:
+        raw = sys.stdin.read()
+        snippets = [s for s in raw.split(args.sep) if s.strip()]
+        names = [f"stdin:{i}" for i in range(len(snippets))]
+    ids, errors = {}, {}
+    for name, code in zip(names, snippets):
+        try:
+            ids[name] = _ingest(engine, cfg, src_vocab, trip_vocab, code,
+                                args.max_new_tokens)
+        except (SyntaxError, ValueError, RecursionError, RuntimeError) as e:
+            errors[name] = f"{type(e).__name__}: {e}"
+    engine.drain()
+    for name in names:
+        if name in errors:
+            print(json.dumps({"source": name, "error": errors[name]}))
+            continue
+        req = engine.poll(ids[name])
+        print(json.dumps({
+            "source": name,
+            "summary": " ".join(engine.words(req)),
+            "n_tokens": req.n_tokens,
+        }))
+    import jax
+
+    print(json.dumps(engine.stats.summary(n_chips=jax.device_count())),
+          file=sys.stderr)
+
+
+def _serve(args) -> None:
+    import select
+
+    engine, cfg, src_vocab, trip_vocab = build_engine(args)
+
+    def flush_finished(pending: dict) -> None:
+        # pop_result keeps the engine's results map bounded over a long run
+        for rid in [r for r in pending if engine.poll(r) is not None]:
+            req = engine.pop_result(rid)
+            print(json.dumps({
+                "id": pending.pop(rid),
+                "summary": " ".join(engine.words(req)),
+                "n_tokens": req.n_tokens,
+                "latency_s": round(req.done_t - req.submit_t, 4),
+            }), flush=True)
+
+    pending: dict = {}
+    n_anon = 0  # monotonic default ids — never reused across the run
+    eof = False
+    # event loop: while work is in flight, poll stdin without blocking and
+    # keep ticking (a client that sends one request and then waits for the
+    # response must not deadlock on our next readline); when idle, block
+    # on stdin until the next request or EOF
+    while not eof or pending or engine.occupancy or engine.queue_depth:
+        busy = bool(pending or engine.occupancy or engine.queue_depth)
+        if not eof:
+            readable, _, _ = select.select([sys.stdin], [], [], 0.0 if busy else None)
+            if readable:
+                line = sys.stdin.readline()
+                if line == "":
+                    eof = True
+                elif line.strip():
+                    try:
+                        rec = json.loads(line)
+                    except json.JSONDecodeError:
+                        rec = {"code": line.rstrip("\n")}
+                    if isinstance(rec, str):
+                        rec = {"code": rec}
+                    ext_id = rec.get("id")
+                    if ext_id is None:
+                        ext_id = n_anon
+                        n_anon += 1
+                    try:
+                        rid = _ingest(
+                            engine, cfg, src_vocab, trip_vocab, rec["code"],
+                            int(rec.get("max_new_tokens", args.max_new_tokens)))
+                        pending[rid] = ext_id
+                    except (KeyError, SyntaxError, ValueError, RecursionError,
+                            RuntimeError) as e:
+                        print(json.dumps(
+                            {"id": ext_id, "error": f"{type(e).__name__}: {e}"}),
+                            flush=True)
+                    continue  # favor draining the input burst before ticking
+        if engine.occupancy or engine.queue_depth:
+            engine.tick()
+        flush_finished(pending)
+    import jax
+
+    print(json.dumps(engine.stats.summary(n_chips=jax.device_count())),
+          file=sys.stderr)
+
+
+def main(argv: Optional[List[str]] = None) -> None:
+    argv = list(sys.argv[1:] if argv is None else argv)
+    if not argv or argv[0] not in ("serve", "summarize"):
+        raise SystemExit("usage: csat_tpu serve|summarize [options] [files ...]")
+    command = argv.pop(0)
+    args = _parser().parse_args(argv)
+    if command == "summarize":
+        _summarize(args)
+    else:
+        _serve(args)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,272 @@
+"""ServeEngine: continuous-batching scheduler over the slot pool.
+
+The engine owns a fixed pool of ``cfg.serve_slots`` decode slots
+(``serve/slots.py``), a FIFO request queue, and two kinds of compiled
+programs: ONE decode-step program advancing every live slot a token, and
+one bucketed prefill program per occupied encoder shape
+(``serve/prefill.py``).  Each :meth:`tick` is one scheduler round:
+
+1. **retire** — rows that emitted EOS or exhausted their token budget hand
+   their generated ids back to their request and free the slot;
+2. **admit** — freed slots refill from the queue head: requests group by
+   smallest-fitting prefill bucket, each group runs the bucket's compiled
+   encoder at its own (smaller) node capacity and scatters memory/cache
+   into the free slot rows;
+3. **decode** — the single decode-step program advances all live slots.
+
+Throughput therefore tracks *real* generated tokens, not bucket capacity:
+a short request never pays a long request's decode tail, and a freed slot
+starts the next request immediately instead of waiting for a whole batch
+to finish.  At steady state nothing recompiles — the compile counter in
+``ServeStats`` is the regression tripwire tests assert on.
+
+Host↔device contract: the pool pytree is donated through every program, so
+slot state lives in place on the device; the per-tick host work is two
+small ``(S,)`` fetches (done flags + positions) plus the queue bookkeeping.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from collections import defaultdict, deque
+from typing import Any, Callable, Deque, Dict, List, Optional, Sequence
+
+import jax
+import numpy as np
+
+from csat_tpu.configs import Config
+from csat_tpu.data.vocab import Vocab
+from csat_tpu.models import CSATrans
+from csat_tpu.serve.prefill import (
+    assign_prefill_bucket,
+    build_prefill,
+    collate_requests,
+    prefill_plan,
+)
+from csat_tpu.serve.slots import SlotPool, build_decode_step, init_pool
+from csat_tpu.serve.stats import ServeStats
+from csat_tpu.utils import EOS_WORD
+
+__all__ = ["Request", "ServeEngine"]
+
+
+@dataclasses.dataclass
+class Request:
+    """One queued/in-flight/finished summarization request.
+
+    ``sample`` is released at retirement (the (N, N) relation matrices are
+    the payload's bulk and are only needed until prefill); ``tokens`` and
+    the timestamps survive."""
+
+    id: int
+    sample: Optional[Dict[str, np.ndarray]]  # flagship-width arrays (serve/ingest.py)
+    limit: int                      # decode-token budget (<= steps)
+    submit_t: float
+    admit_t: Optional[float] = None
+    done_t: Optional[float] = None
+    slot: Optional[int] = None
+    bucket: Optional[int] = None    # prefill bucket index it was admitted at
+    tokens: Optional[np.ndarray] = None  # generated ids incl. the EOS, if any
+    n_tokens: int = 0
+
+    @property
+    def finished(self) -> bool:
+        return self.done_t is not None
+
+
+class ServeEngine:
+    """submit / poll / tick / drain continuous-batching inference engine."""
+
+    def __init__(
+        self,
+        model: CSATrans,
+        params: Any,
+        cfg: Config,
+        tgt_vocab: Optional[Vocab] = None,
+        clock: Callable[[], float] = time.monotonic,
+        sample_seed: int = 0,
+    ):
+        self.model = model
+        self.params = params
+        self.cfg = cfg
+        self.tgt_vocab = tgt_vocab
+        self.clock = clock
+        self.steps = cfg.max_tgt_len - 1
+        self.num_slots = cfg.serve_slots
+        self.specs = prefill_plan(cfg)
+        self.stats = ServeStats(self.num_slots)
+        self.stats.started_t = clock()
+
+        self._pool: SlotPool = init_pool(
+            model, {"params": params}, self.num_slots, self.steps, cfg.max_src_len)
+        self._slots: List[Optional[Request]] = [None] * self.num_slots
+        self._queue: Deque[Request] = deque()
+        self._results: Dict[int, Request] = {}
+        # host mirror of the last decode step's (S, 2) [pos, done] snapshot
+        # — the only per-tick device→host read besides retired token rows
+        self._status: Optional[np.ndarray] = None
+        self._next_id = 0
+        self._n_prefills = 0
+        self._base_key = jax.random.key(cfg.seed + sample_seed)
+
+        # the ONE decode-step program, AOT-compiled up front (pool donated:
+        # slot state advances in place, no per-step copies)
+        step = jax.jit(build_decode_step(model), donate_argnums=(1,))
+        self._decode_prog = step.lower(self.params, self._pool).compile()
+        self.stats.record_compile("decode", (self.num_slots, self.steps))
+        self._prefill_progs: Dict[int, Any] = {}
+
+    # ---------------- public API ----------------
+
+    def submit(self, sample: Dict[str, np.ndarray], max_new_tokens: int = 0) -> int:
+        """Queue one request; returns its id.  ``max_new_tokens`` caps the
+        decode budget (0 = the full ``max_tgt_len - 1`` steps; generation
+        stops earlier at the first EOS either way)."""
+        limit = self.steps if max_new_tokens <= 0 else min(max_new_tokens, self.steps)
+        req = Request(
+            id=self._next_id, sample=sample, limit=limit, submit_t=self.clock())
+        self._next_id += 1
+        self.stats.submitted += 1
+        self._queue.append(req)
+        return req.id
+
+    def poll(self, req_id: int) -> Optional[Request]:
+        """The finished request, or None while queued/in flight."""
+        return self._results.get(req_id)
+
+    def pop_result(self, req_id: int) -> Optional[Request]:
+        """Like :meth:`poll` but removes the finished request — long-running
+        callers (the ``csat_tpu serve`` loop) must use this so the results
+        map stays bounded under sustained traffic."""
+        return self._results.pop(req_id, None)
+
+    def tick(self) -> int:
+        """One scheduler round (retire → admit → decode); returns the number
+        of slots still live afterwards."""
+        self._retire()
+        self._admit()
+        live = sum(r is not None for r in self._slots)
+        if live:
+            self._pool, status = self._decode_prog(self.params, self._pool)
+            self._status = np.asarray(status)
+            self.stats.decode_steps += 1
+        return live
+
+    def drain(self, max_ticks: int = 0) -> Dict[int, Request]:
+        """Run ticks until queue and pool are empty; returns all results."""
+        max_ticks = max_ticks or (len(self._queue) + self.num_slots + 1) * (self.steps + 2)
+        ticks = 0
+        while self._queue or any(r is not None for r in self._slots):
+            self.tick()
+            ticks += 1
+            if ticks > max_ticks:
+                raise RuntimeError(
+                    f"drain exceeded {max_ticks} ticks — a slot is not retiring")
+        self._retire()  # collect rows finished by the final decode step
+        return self._results
+
+    def words(self, req: Request) -> List[str]:
+        """Detokenized summary, truncated at the first EOS (the metric
+        transform's semantics)."""
+        assert self.tgt_vocab is not None, "engine built without a tgt vocab"
+        toks = req.tokens if req.tokens is not None else []
+        out = [self.tgt_vocab.i2w.get(int(t), "<unk>") for t in toks]
+        return out[: out.index(EOS_WORD)] if EOS_WORD in out else out
+
+    @property
+    def occupancy(self) -> int:
+        return sum(r is not None for r in self._slots)
+
+    @property
+    def queue_depth(self) -> int:
+        return len(self._queue)
+
+    def reset_stats(self) -> "ServeStats":
+        """Fresh counters (compile history carried over) — callers warm the
+        programs first, then measure a clean window."""
+        old = self.stats
+        self.stats = ServeStats(self.num_slots)
+        self.stats.compile_events = list(old.compile_events)
+        self.stats.started_t = self.clock()
+        return self.stats
+
+    # ---------------- scheduler internals ----------------
+
+    def _retire(self) -> None:
+        if self._status is None or not any(r is not None for r in self._slots):
+            return
+        pos = self._status[:, 0]
+        done = self._status[:, 1]
+        toks = None
+        now = self.clock()
+        for i, req in enumerate(self._slots):
+            if req is None or not (done[i] or pos[i] >= req.limit):
+                continue
+            if toks is None:
+                toks = np.asarray(self._pool.toks)
+            req.n_tokens = int(pos[i])
+            req.tokens = np.array(toks[i, : req.n_tokens])
+            req.done_t = now
+            req.sample = None  # release the (N, N) payload — prefill is done
+            self.stats.record_request(req.submit_t, req.admit_t, now, req.n_tokens)
+            self._results[req.id] = req
+            self._slots[i] = None
+
+    def _admit(self) -> None:
+        free = [i for i, r in enumerate(self._slots) if r is None]
+        if not free or not self._queue:
+            return
+        take = min(len(free), len(self._queue))
+        window = [self._queue.popleft() for _ in range(take)]
+        groups: Dict[int, List[Request]] = defaultdict(list)
+        for req in window:
+            k = assign_prefill_bucket(self.specs, int(req.sample["num_node"]))
+            req.bucket = k
+            groups[k].append(req)
+        # deterministic admission order: buckets ascending, FIFO within a
+        # bucket, slots assigned in ascending index order
+        for k in sorted(groups):
+            pending = groups[k]
+            while pending:
+                chunk = pending[: self.specs[k].batch_size]
+                pending = pending[len(chunk):]
+                self._prefill_chunk(k, chunk, [free.pop(0) for _ in chunk])
+
+    def _prefill_chunk(self, k: int, chunk: List[Request], slot_ids: List[int]) -> None:
+        spec = self.specs[k]
+        batch = collate_requests([r.sample for r in chunk], spec.n, spec.batch_size, self.cfg)
+        # pad the id/limit vectors to the bucket batch with an out-of-range
+        # sentinel the prefill scatters drop — ragged queues reuse the program
+        ids = np.full((spec.batch_size,), self.num_slots, np.int32)
+        ids[: len(slot_ids)] = slot_ids
+        limits = np.zeros((spec.batch_size,), np.int32)
+        limits[: len(chunk)] = [r.limit for r in chunk]
+        key = jax.random.fold_in(self._base_key, self._n_prefills)
+        self._n_prefills += 1
+        prog = self._prefill_progs.get(k)
+        if prog is None:
+            fn = jax.jit(build_prefill(self.model, spec), donate_argnums=(5,))
+            prog = fn.lower(self.params, batch, ids, limits, key, self._pool).compile()
+            self._prefill_progs[k] = prog
+            self.stats.record_compile("prefill", (spec.n, spec.batch_size))
+        self._pool = prog(self.params, batch, ids, limits, key, self._pool)
+        self.stats.prefill_calls += 1
+        self.stats.admitted += len(chunk)
+        now = self.clock()
+        for req, s in zip(chunk, slot_ids):
+            req.admit_t = now
+            req.slot = s
+            self._slots[s] = req
+
+    # ---------------- conveniences ----------------
+
+    def generate(
+        self,
+        samples: Sequence[Dict[str, np.ndarray]],
+        max_new_tokens: int = 0,
+    ) -> List[Request]:
+        """Submit-and-drain a whole list; results in submission order."""
+        ids = [self.submit(s, max_new_tokens) for s in samples]
+        self.drain()
+        return [self._results[i] for i in ids]

@@ -1,0 +1,90 @@
+"""Request ingestion: raw source code or dataset rows → engine samples.
+
+A *sample* is the per-request dict of flagship-width arrays the prefill
+collate consumes (``serve/prefill.py:collate_requests``): the same fields
+:class:`csat_tpu.data.dataset.ASTDataset` builds per row, minus targets —
+an inference request has no reference summary.
+
+Two producers:
+
+* :func:`sample_from_source` — the online path: one code snippet through
+  the L0 extractor (``data/extract.py``; stdlib-ast fallback or
+  tree-sitter), the L1 matrix builder (``data/ast_tools.py``), and the
+  vocab — exactly the offline preprocessing pipeline, per request.
+* :func:`sample_from_dataset` — the bench/eval path: zero-copy views of a
+  built dataset row.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+import numpy as np
+
+from csat_tpu.configs import Config
+from csat_tpu.data.ast_tools import (
+    ast_json_to_tree,
+    build_matrices,
+    tree_to_record,
+    truncate_preorder,
+)
+from csat_tpu.data.dataset import ASTDataset, gen_tree_positions, node_triplets
+from csat_tpu.data.extract import source_to_ast_json
+from csat_tpu.data.vocab import Vocab
+from csat_tpu.utils import UNK
+
+__all__ = ["sample_from_source", "sample_from_dataset"]
+
+
+def sample_from_source(
+    source: str,
+    cfg: Config,
+    src_vocab: Vocab,
+    trip_vocab: Optional[Vocab] = None,
+    language: str = "",
+) -> Dict[str, np.ndarray]:
+    """One code snippet → a request sample (may raise ``SyntaxError`` etc.
+    on unparseable input — callers surface that per request)."""
+    N = cfg.max_src_len
+    nodes = source_to_ast_json(source, language or cfg.lang)
+    seq = truncate_preorder(ast_json_to_tree(nodes), N)
+    L, T = build_matrices(seq, N)
+    rec = tree_to_record(seq)
+    n = len(rec)
+
+    src_seq = np.zeros((N,), np.int32)
+    ast_tokens = [":".join(e.split(":")[1:-1]) for e in rec.labels[:N]]
+    src_seq[: len(ast_tokens)] = [src_vocab.w2i.get(t, UNK) for t in ast_tokens]
+
+    tp_dim = cfg.tree_pos_width * cfg.tree_pos_height
+    tree_pos = np.zeros((N, tp_dim), np.uint8)
+    tp = gen_tree_positions(rec, cfg.tree_pos_width, cfg.tree_pos_height)
+    tree_pos[: tp.shape[0]] = tp
+
+    triplet = np.zeros((N,), np.int32)
+    trips = node_triplets(rec)
+    triplet[: len(trips)] = (
+        [trip_vocab.w2i.get(t, UNK) for t in trips] if trip_vocab
+        else [UNK] * len(trips)
+    )
+    return {
+        "src_seq": src_seq,
+        "L_raw": L[:N, :N].astype(np.int16),
+        "T_raw": T[:N, :N].astype(np.int16),
+        "num_node": np.asarray(min(n, N), np.int32),
+        "tree_pos": tree_pos,
+        "triplet": triplet,
+    }
+
+
+def sample_from_dataset(dataset: ASTDataset, i: int) -> Dict[str, np.ndarray]:
+    """Row ``i`` of a built dataset as a request sample (views, no copy)."""
+    a = dataset.arrays
+    return {
+        "src_seq": a["src_seq"][i],
+        "L_raw": a["L_raw"][i],
+        "T_raw": a["T_raw"][i],
+        "num_node": a["num_node"][i],
+        "tree_pos": a["tree_pos"][i],
+        "triplet": a["triplet"][i],
+    }

@@ -1,0 +1,171 @@
+"""Bucketed prefill: encoder admission programs + slot writes.
+
+Admission runs the encoder at the **smallest fitting node capacity** from
+the config's bucket ladder (:func:`csat_tpu.data.bucketing.src_bucket_ladder`
+— the same geometries the bucketed trainer compiles, so the persistent
+compilation cache carries encoder programs from training into serving).
+One compiled program exists per occupied ``(n, batch)`` bucket; groups
+smaller than the bucket's batch are row-padded with empty samples whose
+slot ids are an out-of-range sentinel, which the ``mode="drop"`` scatters
+discard — so a ragged queue never mints a new program.
+
+Each prefill call encodes its group, projects the per-layer cross-attention
+K/V from the memory (``CSATrans.project_cross_kv``), pads the memory axis
+with zeros up to the pool's flagship width (exact: padded key lanes are
+masked to -1e9 whose softmax weight underflows to 0.0), and scatters the
+results — plus reset decode state (BOS token, position 0, cleared self-KV
+rows, per-request token budgets) — into the admitted slot rows of the
+donated pool.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, NamedTuple, Sequence, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+
+from csat_tpu.configs import Config
+from csat_tpu.data.bucketing import src_bucket_ladder
+from csat_tpu.data.dataset import Batch, collate
+from csat_tpu.models import CSATrans
+from csat_tpu.serve.slots import SlotPool
+from csat_tpu.utils import BOS, PAD
+
+__all__ = [
+    "PrefillSpec",
+    "prefill_plan",
+    "assign_prefill_bucket",
+    "collate_requests",
+    "build_prefill",
+]
+
+
+class PrefillSpec(NamedTuple):
+    n: int           # AST-node capacity of this prefill shape
+    batch_size: int  # requests admitted per compiled call
+
+
+def prefill_plan(cfg: Config) -> Tuple[PrefillSpec, ...]:
+    """Ascending prefill ladder.  Batch sizes follow the serve node budget
+    (``serve_prefill_budget``; default half the pool at flagship length),
+    capped by the slot count — admitting more rows than free slots exist
+    could never be scattered anyway."""
+    budget = cfg.serve_prefill_budget or max(1, cfg.serve_slots // 2) * cfg.max_src_len
+    return tuple(
+        PrefillSpec(n, min(cfg.serve_slots, max(1, budget // n)))
+        for n in src_bucket_ladder(cfg)
+    )
+
+
+def assign_prefill_bucket(specs: Sequence[PrefillSpec], num_node: int) -> int:
+    """Smallest-fitting bucket index (the flagship always fits: dataset
+    builds and the ingest path both truncate at ``max_src_len``)."""
+    for k, spec in enumerate(specs):
+        if num_node <= spec.n:
+            return k
+    raise ValueError(f"num_node={num_node} exceeds the flagship bucket {specs[-1].n}")
+
+
+def _empty_sample(n: int, tp_dim: int) -> Dict[str, np.ndarray]:
+    """The collate of an absent request: all-PAD tokens, zero relations —
+    identical to :func:`csat_tpu.data.bucketing.pad_batch` row padding."""
+    return {
+        "src_seq": np.zeros((n,), np.int32),
+        "L_raw": np.zeros((n, n), np.int16),
+        "T_raw": np.zeros((n, n), np.int16),
+        "num_node": np.zeros((), np.int32),
+        "tree_pos": np.zeros((n, tp_dim), np.uint8),
+        "triplet": np.zeros((n,), np.int32),
+    }
+
+
+def collate_requests(
+    samples: Sequence[Dict[str, np.ndarray]], n: int, rows: int, cfg: Config,
+    tgt_width: int = 1,
+) -> Batch:
+    """Stack per-request sample dicts (flagship-width arrays, as built by
+    ``serve.ingest``) into a :class:`Batch` at node capacity ``n``,
+    row-padded to ``rows`` with empty samples.  Slicing to ``n`` drops only
+    zero padding (every sample assigned here has ``num_node <= n``), and
+    the shared :func:`~csat_tpu.data.dataset.collate` applies the exact
+    mask-before-offset semantics the training pipeline uses.
+
+    ``tgt_width`` sizes the placeholder target fields: prefill keeps the
+    minimal width 1 (encode never reads them); the batch-at-a-time
+    comparison path passes ``max_tgt_len - 1`` so ``greedy_decode`` reads
+    its step count off the batch as usual."""
+    tp_dim = cfg.tree_pos_width * cfg.tree_pos_height
+    rows_list = list(samples) + [
+        _empty_sample(n, tp_dim) for _ in range(rows - len(samples))
+    ]
+    arrs = {
+        "src_seq": np.stack([np.asarray(s["src_seq"])[:n] for s in rows_list]),
+        # placeholder targets (PAD): decode inputs start from BOS anyway
+        "tgt_seq": np.zeros((rows, tgt_width), np.int32),
+        "target": np.zeros((rows, tgt_width), np.int32),
+        "L_raw": np.stack([np.asarray(s["L_raw"])[:n, :n] for s in rows_list]),
+        "T_raw": np.stack([np.asarray(s["T_raw"])[:n, :n] for s in rows_list]),
+        "num_node": np.asarray([int(s["num_node"]) for s in rows_list], np.int32),
+        "tree_pos": np.stack([np.asarray(s["tree_pos"])[:n] for s in rows_list]),
+        "triplet": np.stack([np.asarray(s["triplet"])[:n] for s in rows_list]),
+    }
+    return collate(arrs, cfg.max_src_len)
+
+
+def build_prefill(model: CSATrans, spec: PrefillSpec):
+    """→ ``prefill(params, batch, slot_ids, limits, sample_key, pool) -> pool``.
+
+    ``slot_ids`` (b,) int32 — destination slot per batch row; out-of-range
+    sentinel rows (padding) are dropped by the scatters.  ``limits`` (b,)
+    int32 — per-request token budgets.  The engine AOT-compiles one of
+    these per occupied bucket, donating the pool.
+    """
+    n = spec.n
+
+    def prefill(params, batch: Batch, slot_ids, limits, sample_key,
+                pool: SlotPool) -> SlotPool:
+        memory, _, _, _, _ = model.apply(
+            {"params": params}, batch, method=CSATrans.encode,
+            rngs={"sample": sample_key},
+        )
+        cross = model.apply({"params": params}, memory, method=CSATrans.project_cross_kv)
+        mem_len = pool.src_mask.shape[1]
+        t_cap = pool.toks.shape[1]
+        b = batch.src_seq.shape[0]
+
+        smask = batch.src_seq == PAD  # (b, n)
+        smask = jnp.pad(smask, ((0, 0), (0, mem_len - n)), constant_values=True)
+
+        cache = {}
+        for layer, entry in pool.cache.items():
+            ck = jnp.pad(
+                cross[layer]["k"], ((0, 0), (0, 0), (0, mem_len - n), (0, 0)))
+            cv = jnp.pad(
+                cross[layer]["v"], ((0, 0), (0, 0), (0, mem_len - n), (0, 0)))
+            cache[layer] = {
+                "self": {
+                    "k": entry["self"]["k"].at[slot_ids].set(0.0, mode="drop"),
+                    "v": entry["self"]["v"].at[slot_ids].set(0.0, mode="drop"),
+                },
+                "cross": {
+                    "k": entry["cross"]["k"].at[slot_ids].set(ck, mode="drop"),
+                    "v": entry["cross"]["v"].at[slot_ids].set(cv, mode="drop"),
+                },
+            }
+        return SlotPool(
+            cache=cache,
+            src_mask=pool.src_mask.at[slot_ids].set(smask, mode="drop"),
+            tok=pool.tok.at[slot_ids].set(
+                jnp.full((b, 1), BOS, jnp.int32), mode="drop"),
+            pos=pool.pos.at[slot_ids].set(0, mode="drop"),
+            limit=pool.limit.at[slot_ids].set(
+                jnp.minimum(limits.astype(jnp.int32), t_cap), mode="drop"),
+            done=pool.done.at[slot_ids].set(False, mode="drop"),
+            prev_pad=pool.prev_pad.at[slot_ids].set(
+                jnp.zeros((b, t_cap), bool), mode="drop"),
+            toks=pool.toks.at[slot_ids].set(
+                jnp.full((b, t_cap), PAD, jnp.int32), mode="drop"),
+        )
+
+    return prefill

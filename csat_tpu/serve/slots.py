@@ -1,0 +1,139 @@
+"""Slot-pooled decode state + the single compiled decode-step program.
+
+The pool is one fixed-shape pytree holding ``S = serve_slots`` in-flight
+requests: per-layer KV cache regions (self-attn ``(S, H, T, dh)`` buffers
+written one position per step; cross-attn ``(S, H, N, dh)`` written once at
+prefill), the per-slot source pad mask, and per-slot decode scalars
+(position, token budget, done flag, the growing output row).  Because every
+array is pre-allocated at ``(S, …)``, *one* jitted program — built once,
+donated pool in / pool out — advances every live slot a token regardless of
+which requests occupy which slots: zero recompiles at steady state, the
+whole point of continuous batching.
+
+Per-row mechanics ride on the generalized decode plumbing
+(``models/csa_trans.py:decode_step`` with a ``(S,)`` position vector;
+``models/components.py:MultiHeadAttention`` per-row cache writes): each
+slot embeds, masks, and cache-writes at *its own* position, so rows
+mid-way through different requests coexist in one program.  A slot is
+**live** when ``pos < limit`` and not ``done``; frozen rows still flow
+through the math (their writes land on dead state and their outputs are
+discarded by the ``act`` gates below), which keeps the program shape
+static — the alternative, compacting live rows, would retrace on every
+occupancy change.
+
+Exactness contract (pinned by ``tests/test_serve.py``): a request decoded
+through the pool emits, per row, the byte-identical token prefix a fresh
+:func:`csat_tpu.train.decode.greedy_decode` of the same request would emit
+(up to its first EOS / token budget) on deterministic configs — the
+per-row math is the scalar scan's math, the one-hot cache write stores the
+same values ``dynamic_update_slice`` would, and masked (-1e9) softmax
+lanes underflow to exact zeros so slot-pool padding never leaks.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, NamedTuple
+
+import jax.numpy as jnp
+
+from csat_tpu.models import CSATrans
+from csat_tpu.utils import EOS, PAD
+
+__all__ = ["SlotPool", "init_pool", "build_decode_step"]
+
+
+class SlotPool(NamedTuple):
+    """Device-resident slot state; a pytree donated through every program."""
+
+    cache: Dict[str, Any]   # per-layer {"self": {k,v (S,H,T,dh)}, "cross": {k,v (S,H,N,dh)}}
+    src_mask: jnp.ndarray   # (S, N) bool — True = pad key (all-True when free)
+    tok: jnp.ndarray        # (S, 1) int32 — current decoder input token
+    pos: jnp.ndarray        # (S,) int32 — tokens generated so far
+    limit: jnp.ndarray      # (S,) int32 — per-request budget; 0 ⇒ slot frozen
+    done: jnp.ndarray       # (S,) bool — row emitted EOS
+    prev_pad: jnp.ndarray   # (S, T) bool — pad-ness of decoder inputs so far
+    toks: jnp.ndarray       # (S, T) int32 — generated ids (PAD beyond pos)
+
+
+def init_pool(model: CSATrans, variables: Any, num_slots: int, steps: int,
+              mem_len: int) -> SlotPool:
+    """A pool of ``num_slots`` empty slots with a ``steps``-token decode
+    budget capacity and ``mem_len``-wide encoder memory regions.  Every
+    slot starts frozen (``limit = 0``); prefill writes bring slots live."""
+    cache = model.apply(
+        variables, num_slots, steps, mem_len, method=CSATrans.init_slot_cache
+    )
+    return SlotPool(
+        cache=cache,
+        src_mask=jnp.ones((num_slots, mem_len), dtype=bool),
+        tok=jnp.full((num_slots, 1), PAD, dtype=jnp.int32),
+        pos=jnp.zeros((num_slots,), dtype=jnp.int32),
+        limit=jnp.zeros((num_slots,), dtype=jnp.int32),
+        done=jnp.zeros((num_slots,), dtype=bool),
+        prev_pad=jnp.zeros((num_slots, steps), dtype=bool),
+        toks=jnp.full((num_slots, steps), PAD, dtype=jnp.int32),
+    )
+
+
+def build_decode_step(model: CSATrans):
+    """→ ``step(params, pool) -> (pool, status)``: advance every live slot
+    one token.  Pure and shape-stable — the engine AOT-compiles it exactly
+    once (donating the pool) and dispatches the same executable forever.
+
+    ``status`` is a packed ``(S, 2)`` int32 ``[pos, done]`` snapshot — the
+    scheduler's entire per-tick host read in ONE device→host transfer
+    (fetching ``pool.pos`` and ``pool.done`` separately would double the
+    per-token sync cost, which is the engine's main overhead over the
+    lockstep scan).
+    """
+
+    def step(params, pool: SlotPool):
+        # assemble the model-facing cache: per-slot positions thread in as
+        # the (S,) idx vector (per-row one-hot writes in MultiHeadAttention)
+        cache = {
+            layer: {
+                "self": {**entry["self"], "idx": pool.pos},
+                "cross": entry["cross"],
+            }
+            for layer, entry in pool.cache.items()
+        }
+        log_probs, new_cache = model.apply(
+            {"params": params}, pool.tok, pool.pos, cache, None,
+            pool.src_mask, pool.prev_pad, method=CSATrans.decode_step,
+        )
+        nxt = jnp.argmax(log_probs, axis=-1).astype(jnp.int32)  # (S,)
+        act = (~pool.done) & (pool.pos < pool.limit)
+        nxt = jnp.where(act, nxt, PAD)
+
+        t_cap = pool.toks.shape[1]
+        ar = jnp.arange(t_cap)[None, :]
+        write = (ar == pool.pos[:, None]) & act[:, None]
+        toks = jnp.where(write, nxt[:, None], pool.toks)
+        # pad-ness of the token that will sit at input position pos+1 —
+        # the reference's make_std_mask(ys, 0) semantics, exactly as the
+        # lockstep scan records them (a write at pos+1 >= T is a no-op,
+        # mirroring the scan's `i + 1 < steps` cond)
+        write_next = (ar == (pool.pos + 1)[:, None]) & act[:, None]
+        prev_pad = jnp.where(write_next, (nxt == PAD)[:, None], pool.prev_pad)
+
+        done = pool.done | (act & (nxt == EOS))
+        pos = jnp.where(act, pool.pos + 1, pool.pos)
+        tok = jnp.where(act[:, None], nxt[:, None], pool.tok)
+        # keep the engine's position threading authoritative: drop the
+        # attention-advanced idx, keep the updated K/V buffers (frozen
+        # rows' writes touched only their dead, not-yet-read position)
+        cache_out = {
+            layer: {
+                "self": {"k": entry["self"]["k"], "v": entry["self"]["v"]},
+                "cross": entry["cross"],
+            }
+            for layer, entry in new_cache.items()
+        }
+        new_pool = SlotPool(
+            cache=cache_out, src_mask=pool.src_mask, tok=tok, pos=pos,
+            limit=pool.limit, done=done, prev_pad=prev_pad, toks=toks,
+        )
+        status = jnp.stack([pos, done.astype(jnp.int32)], axis=1)
+        return new_pool, status
+
+    return step

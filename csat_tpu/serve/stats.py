@@ -1,0 +1,98 @@
+"""Serving observability: per-request latency records + engine counters.
+
+The engine calls :meth:`ServeStats.record_compile` whenever it builds a
+compiled program (the serving-regression tripwire: steady state must hold
+at ONE decode-step program plus one prefill program per occupied bucket),
+and :meth:`ServeStats.record_request` as each request retires.
+:meth:`ServeStats.summary` renders the numbers the ``:serve`` bench mode
+and the CLI report: request-latency percentiles and generated-token
+throughput, per chip and per slot.
+"""
+
+from __future__ import annotations
+
+from collections import deque
+from typing import Deque, Dict, List, Optional, Sequence, Tuple
+
+__all__ = ["ServeStats", "percentile"]
+
+# latency/wait percentile window: bounded so a long-running server's stats
+# stay O(1) in memory (percentiles then describe the most recent window)
+LATENCY_WINDOW = 10_000
+
+
+def percentile(values: Sequence[float], q: float) -> float:
+    """Nearest-rank percentile (q in [0, 100]) without a NumPy dependency
+    in the hot path; 0.0 on empty input."""
+    if not values:
+        return 0.0
+    xs = sorted(values)
+    k = max(0, min(len(xs) - 1, int(round(q / 100.0 * (len(xs) - 1)))))
+    return float(xs[k])
+
+
+class ServeStats:
+    def __init__(self, num_slots: int):
+        self.num_slots = num_slots
+        # (kind, detail) per compiled-program build, in build order —
+        # tests assert this list stops growing after warm-up
+        self.compile_events: List[Tuple[str, Tuple]] = []
+        self.submitted = 0
+        self.admitted = 0
+        self.retired = 0
+        self.decode_steps = 0      # engine ticks that ran the decode program
+        self.prefill_calls = 0
+        self.gen_tokens = 0        # real tokens delivered to finished requests
+        self.wait_s: Deque[float] = deque(maxlen=LATENCY_WINDOW)     # submit → admit
+        self.latency_s: Deque[float] = deque(maxlen=LATENCY_WINDOW)  # submit → done
+        self.first_done_t: Optional[float] = None
+        self.last_done_t: Optional[float] = None
+        self.started_t: Optional[float] = None
+
+    # ---------------- recording ----------------
+
+    def record_compile(self, kind: str, detail: Tuple) -> None:
+        self.compile_events.append((kind, tuple(detail)))
+
+    @property
+    def compiles(self) -> int:
+        return len(self.compile_events)
+
+    def record_request(self, submit_t: float, admit_t: float, done_t: float,
+                       n_tokens: int) -> None:
+        self.retired += 1
+        self.gen_tokens += int(n_tokens)
+        self.wait_s.append(admit_t - submit_t)
+        self.latency_s.append(done_t - submit_t)
+        if self.first_done_t is None:
+            self.first_done_t = done_t
+        self.last_done_t = done_t
+
+    # ---------------- reporting ----------------
+
+    def summary(self, wall_s: Optional[float] = None, n_chips: int = 1) -> Dict[str, float]:
+        """Throughput is credited over ``wall_s`` when the caller measured a
+        whole run (the bench), else over the submit→last-retire span."""
+        if wall_s is None:
+            t0 = self.started_t
+            t1 = self.last_done_t
+            wall_s = (t1 - t0) if (t0 is not None and t1 is not None) else 0.0
+        tps = self.gen_tokens / wall_s if wall_s > 0 else 0.0
+        return {
+            "num_slots": self.num_slots,
+            "submitted": self.submitted,
+            "admitted": self.admitted,
+            "retired": self.retired,
+            "decode_steps": self.decode_steps,
+            "prefill_calls": self.prefill_calls,
+            "compiles": self.compiles,
+            "gen_tokens": self.gen_tokens,
+            "wall_s": round(wall_s, 3),
+            "gen_tokens_per_sec": round(tps, 2),
+            "gen_tokens_per_sec_per_chip": round(tps / max(n_chips, 1), 2),
+            "gen_tokens_per_sec_per_slot": round(tps / max(self.num_slots, 1), 2),
+            "latency_p50_s": round(percentile(self.latency_s, 50), 4),
+            "latency_p95_s": round(percentile(self.latency_s, 95), 4),
+            "wait_p50_s": round(percentile(self.wait_s, 50), 4),
+            "wait_p95_s": round(percentile(self.wait_s, 95), 4),
+        }

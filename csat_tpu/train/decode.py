@@ -30,6 +30,7 @@ at their bucket's T capacity with the same compiled program per shape.
 
 from __future__ import annotations
 
+import functools
 from typing import Any
 
 import jax
@@ -40,6 +41,29 @@ from csat_tpu.models import CSATrans
 from csat_tpu.utils import BOS, EOS, PAD
 
 __all__ = ["greedy_decode", "greedy_decode_nocache", "greedy_decode_early_eos"]
+
+
+@functools.lru_cache(maxsize=8)
+def _nocache_forward(model: CSATrans):
+    """Model-keyed jitted teacher-forced forward for the nocache decoder.
+
+    Previously the ``@jax.jit`` closure was re-created inside every
+    :func:`greedy_decode_nocache` call, so jit's shape cache never hit and
+    every eval batch paid a full recompile.  Hoisted here, the jitted callable
+    is stable per model (linen modules hash by construction args) and
+    jit's own shape-keyed cache takes over — the same pattern as the train
+    step's ``ProgramCache``.  ``variables``/``batch``/``key`` are traced
+    arguments, so changing params or shapes never rebuilds the function.
+    """
+
+    @jax.jit
+    def forward(variables, batch: Batch, sample_key):
+        log_probs, *_ = model.apply(
+            variables, batch, method=CSATrans.__call__, rngs={"sample": sample_key}
+        )
+        return log_probs
+
+    return forward
 
 
 def greedy_decode(
@@ -96,6 +120,9 @@ def greedy_decode_nocache(
     Uses one jitted teacher-forced forward with future positions padded to
     PAD — for position i this is equivalent to the reference's length-(i+1)
     prefix rerun, because ``make_std_mask`` hides both pads and futures.
+    The forward comes from the model-keyed :func:`_nocache_forward` cache,
+    so repeated eval calls reuse one compiled program per batch shape
+    instead of recompiling per invocation.
     """
     steps = batch.tgt_seq.shape[1]
     b = batch.src_seq.shape[0]
@@ -104,17 +131,14 @@ def greedy_decode_nocache(
         # instead of tripping over the unbound ``last`` below
         return jnp.zeros((b, 0), dtype=jnp.int32)
 
-    @jax.jit
-    def forward(tgt_seq):
-        b2 = batch._replace(tgt_seq=tgt_seq)
-        log_probs, *_ = model.apply(
-            variables, b2, method=CSATrans.__call__, rngs={"sample": sample_key}
-        )
-        return log_probs
-
+    forward = _nocache_forward(model)
+    # one host→device transfer up front: the batch is now a traced argument
+    # (it was a closure constant before), so keep it device-resident across
+    # the per-position calls instead of re-feeding numpy each step
+    batch = Batch(*(jnp.asarray(x) for x in batch))
     ys = jnp.full((b, steps), PAD, dtype=jnp.int32).at[:, 0].set(BOS)
     for i in range(steps):
-        log_probs = forward(ys)
+        log_probs = forward(variables, batch._replace(tgt_seq=ys), sample_key)
         nxt = jnp.argmax(log_probs[:, i], axis=-1).astype(jnp.int32)
         if i + 1 < steps:
             ys = ys.at[:, i + 1].set(nxt)

@@ -745,7 +745,15 @@ class Trainer:
                     # training and desynchronize it_done from what the
                     # state actually contains (the preemption marker relies
                     # on that correspondence)
-                    losses = []
+                    # on-device nan-safe running loss sum/count: non-finite
+                    # losses from guarded (skipped) steps are excluded on
+                    # device, epoch memory no longer grows with step count
+                    # (the old per-step `losses` list pinned every loss
+                    # scalar until the epoch-end nanmean), and the epoch-end
+                    # host sync shrinks to two scalars
+                    loss_sum = jnp.zeros((), jnp.float32)
+                    loss_cnt = jnp.zeros((), jnp.float32)
+                    last_loss = None
                     rolled_back = False
                     batches: Iterable[Batch] = self._train_batches(
                         train_ds, epoch,
@@ -769,7 +777,10 @@ class Trainer:
                         it_done += 1
                         if watchdog is not None:
                             watchdog.beat()
-                        losses.append(metrics["loss"])
+                        last_loss = metrics["loss"]
+                        finite = jnp.isfinite(last_loss)
+                        loss_sum = loss_sum + jnp.where(finite, last_loss, 0.0)
+                        loss_cnt = loss_cnt + finite
                         if it % 50 == 0 and cfg.scalar_log:
                             # per-iteration scalar cadence mirrors the reference's
                             # every-50-iters TensorBoard loss (train.py:212-217).
@@ -820,14 +831,15 @@ class Trainer:
                     # validation decodes / checkpoint drains run at their own
                     # cadence — the next train step's beat re-arms
                     watchdog.disarm()
-                if cfg.profile and epoch == start_epoch and losses:
-                    jax.block_until_ready(losses[-1])
+                if cfg.profile and epoch == start_epoch and last_loss is not None:
+                    jax.block_until_ready(last_loss)
                     jax.profiler.stop_trace()
-                # nanmean: identical to mean on healthy epochs; a guarded
-                # run's skipped steps may log NaN losses without poisoning
-                # the epoch statistic
-                mean_loss = (float(jnp.nanmean(jnp.stack(losses)))
-                             if losses else float("nan"))
+                # finite-gated running mean == nanmean of the per-step list
+                # on any epoch: identical to the plain mean on healthy ones,
+                # and a guarded run's skipped steps can log NaN losses
+                # without poisoning the statistic
+                cnt = float(loss_cnt)
+                mean_loss = float(loss_sum) / cnt if cnt else float("nan")
                 history["loss"].append(mean_loss)
                 self._scalar(epoch=epoch, loss=mean_loss, wall_s=round(time.time() - t0, 1))
                 msg = f"epoch {epoch}: loss={mean_loss:.4f} ({time.time()-t0:.1f}s)"

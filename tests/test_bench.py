@@ -71,7 +71,7 @@ def test_alive_tpu_best_variant_wins(bench, monkeypatch, capsys):
     # fixed-shape protocol
     assert out["value"] == 103.0
     assert "degraded" not in out
-    assert len(out["all_variants"]) == 5
+    assert len(out["all_variants"]) == 6
     # one probe + ONE serve for the whole device group (single claim)
     assert [c[0] for c in calls] == ["--probe", "--serve"]
 
@@ -123,7 +123,7 @@ def test_killed_serve_retries_untried_first(bench, monkeypatch, capsys):
     monkeypatch.setattr(bench, "_run_child", fake_child)
     out = _run_main(bench, capsys)
     assert state["round"] == 2
-    assert len(out["all_variants"]) == 5
+    assert len(out["all_variants"]) == 6
     assert out["value"] == 300.0
     assert "killed during" not in out.get("notes", "")  # retried successfully
 
@@ -149,7 +149,7 @@ def test_deterministic_error_not_retried(bench, monkeypatch, capsys):
     out = _run_main(bench, capsys)
     assert state["serves"] == 1  # error is final: no retry round
     assert "non-finite" in out["notes"]
-    assert len(out["all_variants"]) == 4
+    assert len(out["all_variants"]) == 5
 
 
 def test_malformed_bench_variants_flagged(bench, monkeypatch, capsys):
@@ -191,7 +191,7 @@ def test_done_record_authoritative_over_stdout_marker(bench, monkeypatch, capsys
     out = _run_main(bench, capsys)
     assert state["serves"] == 1  # done record suppressed the retry round
     assert "serve:" not in out.get("notes", "")
-    assert len(out["all_variants"]) == 5
+    assert len(out["all_variants"]) == 6
     assert "degraded" not in out
 
 

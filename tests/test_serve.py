@@ -1,0 +1,327 @@
+"""Continuous-batching inference engine (ISSUE 3 tentpole).
+
+Pins the engine's four contracts:
+
+* **exactness** — a request decoded through the slot pool emits the
+  bit-identical token prefix a fresh ``greedy_decode`` of the same request
+  emits (up to its EOS / token budget), under mixed-length queues and
+  across slot reuse (more requests than slots);
+* **scheduling** — admission order is a deterministic function of the
+  submitted trace (bucket-grouped FIFO, ascending slot ids), EOS retires a
+  row and its freed slot refills from the queue;
+* **compile discipline** — steady state holds at exactly ONE decode-step
+  program plus one prefill program per occupied bucket: replaying a warm
+  trace adds zero compiles (the serving-regression tripwire);
+* **throughput** (slow) — on a skewed-length Poisson trace the engine
+  moves more generated tokens per second than batch-at-a-time
+  ``greedy_decode`` over the same requests.
+"""
+
+import jax
+import numpy as np
+import pytest
+
+from csat_tpu.data.toy import random_request_sample
+from csat_tpu.serve import (
+    ServeEngine,
+    assign_prefill_bucket,
+    collate_requests,
+    prefill_plan,
+)
+from csat_tpu.utils import EOS
+
+
+@pytest.fixture(scope="module")
+def serve_cfg(micro_config):
+    """Deterministic micro config on the paths where bit-identity holds
+    (full attention, zero dropout, shape-invariant CSE empty rows), with a
+    4-slot pool over a 2-bucket prefill ladder."""
+    return micro_config.replace(
+        full_att=True, dropout=0.0, attention_dropout=0.0,
+        cse_empty_rows="zero", serve_slots=4,
+        bucket_src_lens=(24, 48),
+    )
+
+
+SRC_V, TGT_V, TRIP_V = 200, 300, 50
+
+
+@pytest.fixture(scope="module")
+def served(serve_cfg):
+    """(cfg, model, params, engine) — one engine shared by the module; each
+    test submits its own requests (the pool drains between tests)."""
+    from csat_tpu.train.state import create_train_state, default_optimizer, make_model
+
+    cfg = serve_cfg
+    model = make_model(cfg, SRC_V, TGT_V, TRIP_V)
+    warm = collate_requests(
+        [random_request_sample(cfg, SRC_V, TRIP_V, 8, seed=0)],
+        cfg.max_src_len, 1, cfg, tgt_width=cfg.max_tgt_len - 1)
+    params = create_train_state(
+        model, default_optimizer(cfg), warm, seed=0).params
+    engine = ServeEngine(model, params, cfg)
+    return cfg, model, params, engine
+
+
+def _requests(cfg, n, seed=0, lo=5):
+    rng = np.random.default_rng(seed)
+    return [
+        random_request_sample(cfg, SRC_V, TRIP_V, int(ln), seed=1000 * seed + i)
+        for i, ln in enumerate(rng.integers(lo, cfg.max_src_len, n))
+    ]
+
+
+def _fresh_decode(cfg, model, params, sample):
+    """Reference decode of one request at the flagship shape."""
+    from csat_tpu.train.decode import greedy_decode
+
+    batch = collate_requests(
+        [sample], cfg.max_src_len, 1, cfg, tgt_width=cfg.max_tgt_len - 1)
+    return np.asarray(
+        greedy_decode(model, {"params": params}, batch, jax.random.key(7)))[0]
+
+
+# ---------------------------------------------------------------------------
+# exactness
+# ---------------------------------------------------------------------------
+
+
+def test_engine_bit_identical_to_fresh_greedy_decode(served):
+    """Mixed-length queue, 3x oversubscribed pool: every request's emitted
+    prefix equals a fresh greedy_decode of that request alone."""
+    cfg, model, params, engine = served
+    samples = _requests(cfg, 3 * cfg.serve_slots, seed=1)
+    reqs = engine.generate(samples)
+    assert {r.bucket for r in reqs} == {0, 1}, "trace must occupy both buckets"
+    assert all(r.slot is not None for r in reqs)
+    # slot reuse actually happened: more requests than slots
+    assert len(reqs) > cfg.serve_slots
+    for req, sample in zip(reqs, samples):
+        ref = _fresh_decode(cfg, model, params, sample)
+        assert req.n_tokens > 0
+        np.testing.assert_array_equal(np.asarray(req.tokens), ref[: req.n_tokens])
+
+
+def test_budgeted_requests_retire_and_match_prefix(served):
+    """Per-request token budgets force mid-decode retirement + refill; the
+    shortened outputs still match the fresh decode's prefix."""
+    cfg, model, params, engine = served
+    steps = cfg.max_tgt_len - 1
+    samples = _requests(cfg, 2 * cfg.serve_slots, seed=2)
+    budgets = [1 + (i % steps) for i in range(len(samples))]
+    ids = [engine.submit(s, max_new_tokens=b) for s, b in zip(samples, budgets)]
+    engine.drain()
+    for rid, sample, budget in zip(ids, samples, budgets):
+        req = engine.poll(rid)
+        assert req.n_tokens <= budget
+        ref = _fresh_decode(cfg, model, params, sample)
+        np.testing.assert_array_equal(np.asarray(req.tokens), ref[: req.n_tokens])
+
+
+def test_eos_retires_row_and_refills_slot(served):
+    """With the generator biased hard toward EOS every request emits EOS at
+    its first step: rows retire by EOS (not budget) and freed slots turn
+    the whole queue over through the 4-slot pool."""
+    cfg, model, params, engine = served
+    eos_params = jax.tree_util.tree_map_with_path(
+        lambda path, x: x + 1e6 * (np.arange(x.shape[-1]) == EOS)
+        if (x.ndim == 1 and "generator" in str(path) and "bias" in str(path))
+        else x,
+        params,
+    )
+    eng2 = ServeEngine(model, eos_params, cfg)
+    samples = _requests(cfg, 2 * cfg.serve_slots + 1, seed=3)
+    reqs = eng2.generate(samples)
+    for req in reqs:
+        assert req.n_tokens == 1
+        assert int(req.tokens[0]) == EOS
+    assert eng2.stats.retired == len(samples)
+
+
+# ---------------------------------------------------------------------------
+# scheduling + compile discipline (the tier-1 serving-regression gate)
+# ---------------------------------------------------------------------------
+
+
+def test_deterministic_admission_and_no_steady_state_recompile(served):
+    """Same seeded trace twice: identical admission order (request →
+    (bucket, slot) assignments) and ZERO new programs after warm-up —
+    steady state is exactly one decode-step program plus one prefill
+    program per occupied bucket, asserted via the engine's compile hook."""
+    cfg, model, params, engine = served
+    specs = prefill_plan(cfg)
+
+    def run_trace(eng):
+        samples = _requests(cfg, 2 * cfg.serve_slots + 3, seed=4)
+        reqs = eng.generate(samples, max_new_tokens=3)
+        return [(r.id - reqs[0].id, r.bucket, r.slot, r.n_tokens) for r in reqs]
+
+    a = run_trace(engine)
+    compiles_after_warm = engine.stats.compiles
+    occupied = {b for _, b, _, _ in a}
+    # exactly one decode program + one prefill program per OCCUPIED bucket
+    kinds = [k for k, _ in engine.stats.compile_events]
+    assert kinds.count("decode") == 1
+    assert sum(1 for k in kinds if k == "prefill") >= len(occupied)
+    prefill_shapes = {d for k, d in engine.stats.compile_events if k == "prefill"}
+    assert {(specs[b].n, specs[b].batch_size) for b in occupied} <= prefill_shapes
+
+    b = run_trace(engine)
+    assert a == b, "admission schedule must be a pure function of the trace"
+    assert engine.stats.compiles == compiles_after_warm, (
+        "steady-state serving must not compile new programs")
+
+
+def test_ragged_tail_group_reuses_bucket_program(served):
+    """A group smaller than the bucket batch is row-padded with sentinel
+    slot ids — no new program, and the padding rows stay free."""
+    cfg, model, params, engine = served
+    engine.generate(_requests(cfg, 2 * cfg.serve_slots, seed=5))  # warm
+    n0 = engine.stats.compiles
+    reqs = engine.generate(_requests(cfg, 1, seed=6))  # 1-request tail
+    assert engine.stats.compiles == n0
+    assert engine.occupancy == 0 and reqs[0].finished
+
+
+def test_prefill_plan_and_bucket_assignment(serve_cfg):
+    specs = prefill_plan(serve_cfg)
+    assert [s.n for s in specs] == [24, 48]
+    assert all(1 <= s.batch_size <= serve_cfg.serve_slots for s in specs)
+    assert assign_prefill_bucket(specs, 10) == 0
+    assert assign_prefill_bucket(specs, 24) == 0
+    assert assign_prefill_bucket(specs, 25) == 1
+    with pytest.raises(ValueError):
+        assign_prefill_bucket(specs, 49)
+
+
+def test_stats_latency_and_throughput_counters(served):
+    cfg, model, params, engine = served
+    engine.reset_stats()
+    samples = _requests(cfg, cfg.serve_slots + 2, seed=7)
+    reqs = engine.generate(samples, max_new_tokens=4)
+    s = engine.stats.summary(n_chips=1)
+    assert s["retired"] == len(samples)
+    assert s["gen_tokens"] == sum(r.n_tokens for r in reqs) > 0
+    assert s["gen_tokens_per_sec"] > 0
+    assert 0 <= s["latency_p50_s"] <= s["latency_p95_s"]
+    assert 0 <= s["wait_p50_s"] <= s["latency_p95_s"]
+    assert s["compiles"] >= 1  # compile history survives reset_stats
+
+
+# ---------------------------------------------------------------------------
+# throughput (slow): the serving win over batch-at-a-time decode
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.slow
+def test_poisson_trace_beats_batch_at_a_time_decode(served):
+    """Skewed lengths + skewed budgets: the engine's generated-token
+    throughput beats assembling full batches and running the fixed-step
+    ``greedy_decode`` eval helper over the same requests."""
+    import time
+
+    from csat_tpu.train.decode import greedy_decode
+
+    cfg, model, params, engine = served
+    steps = cfg.max_tgt_len - 1
+    rng = np.random.default_rng(8)
+    n_req = 6 * cfg.serve_slots
+    lengths = np.clip(
+        (cfg.max_src_len * rng.lognormal(-1.2, 0.6, n_req)).astype(int),
+        4, cfg.max_src_len)
+    budgets = np.clip(
+        (steps * rng.lognormal(-1.1, 0.5, n_req)).astype(int), 1, steps)
+    samples = [
+        random_request_sample(cfg, SRC_V, TRIP_V, int(lengths[i]), seed=5000 + i)
+        for i in range(n_req)
+    ]
+
+    # warm both paths before timing
+    engine.generate(samples[: cfg.serve_slots], max_new_tokens=1)
+    decode = jax.jit(lambda p, b, k: greedy_decode(model, {"params": p}, b, k))
+    warm_b = collate_requests(samples[:cfg.serve_slots], cfg.max_src_len,
+                              cfg.serve_slots, cfg, tgt_width=steps)
+    jax.block_until_ready(decode(params, warm_b, jax.random.key(0)))
+
+    t0 = time.perf_counter()
+    ids = [engine.submit(s, max_new_tokens=int(b))
+           for s, b in zip(samples, budgets)]
+    engine.drain()
+    t_engine = time.perf_counter() - t0
+    useful = sum(engine.poll(i).n_tokens for i in ids)
+
+    t0 = time.perf_counter()
+    base_useful = 0
+    for s0 in range(0, n_req, cfg.serve_slots):
+        chunk = samples[s0: s0 + cfg.serve_slots]
+        batch = collate_requests(chunk, cfg.max_src_len, cfg.serve_slots,
+                                 cfg, tgt_width=steps)
+        y = np.asarray(decode(params, batch, jax.random.key(0)))
+        for row in range(len(chunk)):
+            budget = int(budgets[s0 + row])
+            eos = np.flatnonzero(y[row] == EOS)
+            gen = int(eos[0]) + 1 if len(eos) else steps
+            base_useful += min(gen, budget)
+    t_batch = time.perf_counter() - t0
+
+    assert useful == base_useful, "both paths must credit the same tokens"
+    tps_engine = useful / t_engine
+    tps_batch = base_useful / t_batch
+    assert tps_engine > tps_batch, (
+        f"continuous batching {tps_engine:.1f} tok/s must beat "
+        f"batch-at-a-time {tps_batch:.1f} tok/s on a skewed trace")
+
+
+# ---------------------------------------------------------------------------
+# ingest: raw source code → request → summary words
+# ---------------------------------------------------------------------------
+
+
+def test_ingest_source_through_engine(served):
+    """The online path: a Python snippet through the L0/L1 extraction
+    pipeline, the engine, and detokenization."""
+    from csat_tpu.data.vocab import Vocab
+    from csat_tpu.serve import sample_from_source
+    from csat_tpu.utils import EOS_WORD
+
+    cfg, model, params, engine = served
+    code = "def load_cache(path, limit):\n    return parse_index(path)[:limit]\n"
+    sample = sample_from_source(code, cfg, Vocab(need_bos=False))
+    assert 0 < int(sample["num_node"]) <= cfg.max_src_len
+    assert sample["src_seq"].shape == (cfg.max_src_len,)
+    assert sample["L_raw"].shape == (cfg.max_src_len, cfg.max_src_len)
+    # antisymmetric raw distances, zero diagonal — the collate contract
+    assert (sample["L_raw"] == -sample["L_raw"].T).all()
+
+    req = engine.generate([sample], max_new_tokens=5)[0]
+    assert req.finished and req.n_tokens >= 1
+    engine.tgt_vocab = Vocab(need_bos=True)
+    words = engine.words(req)
+    assert isinstance(words, list) and EOS_WORD not in words
+    engine.tgt_vocab = None
+
+
+# ---------------------------------------------------------------------------
+# decode satellites
+# ---------------------------------------------------------------------------
+
+
+def test_nocache_forward_is_cached_per_model(served):
+    """The nocache decoder's jitted forward is hoisted out of the per-call
+    closure: same model → same jitted callable, so jit's shape cache can
+    hit across eval batches instead of recompiling each call."""
+    from csat_tpu.train.decode import _nocache_forward, greedy_decode_nocache
+
+    cfg, model, params, engine = served
+    assert _nocache_forward(model) is _nocache_forward(model)
+    sample = _requests(cfg, 1, seed=9)[0]
+    batch = collate_requests([sample], cfg.max_src_len, 1, cfg,
+                             tgt_width=cfg.max_tgt_len - 1)
+    a = np.asarray(greedy_decode_nocache(
+        model, {"params": params}, batch, jax.random.key(3)))
+    b = np.asarray(greedy_decode_nocache(
+        model, {"params": params}, batch, jax.random.key(3)))
+    np.testing.assert_array_equal(a, b)
+    # and the cached-forward path still agrees with the KV-cache decoder
+    ref = _fresh_decode(cfg, model, params, sample)
+    np.testing.assert_array_equal(a[0], ref)
