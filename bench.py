@@ -501,6 +501,13 @@ def _measure_serve(backend: str, dtype: str, num_slots: int,
         "vs_batch_decode": round(tps / base_tps, 3) if base_tps > 0 else 0.0,
         "latency_p50_s": round(percentile(lat, 50), 4),
         "latency_p95_s": round(percentile(lat, 95), 4),
+        # serving-resilience outcome counters (serve/stats.py): all zero on
+        # a healthy bench run — nonzero values in a saved record mean the
+        # measurement itself hit faults and the throughput is suspect
+        "req_failed": engine.stats.failed,  # quarantined is a subset of failed
+        "req_timeouts": engine.stats.timeouts,
+        "req_rejected": engine.stats.rejected + engine.stats.shed,
+        "pool_rebuilds": engine.stats.rebuilds,
         # keep the shared-record contract so the variant table renders
         "nodes_per_sec_per_chip": 0.0,
         "real_nodes_per_sec_per_chip": 0.0,

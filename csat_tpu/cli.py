@@ -62,6 +62,16 @@ def _train_main() -> None:
                    help="malformed training batches to quarantine-and-skip "
                         "before failing loud; default keeps the config's "
                         "value")
+    p.add_argument("--watchdog_device_probe", action="store_true",
+                   help="add the chained-collective device-liveness leg to "
+                        "the step watchdog (catches hangs the async "
+                        "dispatch queue masks)")
+    p.add_argument("--snapshot_every_steps", type=int, default=-1,
+                   help="refresh the guard's rollback snapshot every N "
+                        "known-good iterations (at the guard-check "
+                        "cadence) and replay only the since-snapshot "
+                        "window; 0 = epoch-granular, default keeps the "
+                        "config's value")
     p.add_argument("--bucketing", action="store_true",
                    help="length-bucketed execution: collate each sample at "
                         "the smallest fitting (N, T) bucket with node-budget "
@@ -101,6 +111,10 @@ def _train_main() -> None:
         overrides["watchdog_timeout_s"] = args.watchdog_timeout_s
     if args.data_error_budget >= 0:
         overrides["data_error_budget"] = args.data_error_budget
+    if args.watchdog_device_probe:
+        overrides["watchdog_device_probe"] = True
+    if args.snapshot_every_steps >= 0:
+        overrides["snapshot_every_steps"] = args.snapshot_every_steps
     if args.bucketing:
         overrides["bucketing"] = True
     if args.bucket_src_lens:

@@ -157,6 +157,56 @@ class Config:
     # flagship-length prefills land in half-pool batches, short ones in
     # proportionally larger batches up to the pool size
     serve_prefill_budget: int = 0
+    # --- serving resilience (csat_tpu/serve/engine.py) ---
+    # admission control: bound on the engine's request queue (queued, not
+    # in-flight). 0 = unbounded (the PR-3 behavior). When full, submit
+    # resolves the new request to a structured terminal outcome instead of
+    # growing the queue without bound
+    serve_max_queue: int = 0
+    # what a full queue does: "reject" resolves the NEW request as
+    # REJECTED; "shed_oldest" sheds the oldest QUEUED request (SHED) and
+    # admits the new one — freshest-work-wins for latency-sensitive traffic
+    serve_queue_policy: str = "reject"
+    # default per-request deadline (seconds from submit; submit's
+    # deadline_s overrides). Expired queued requests resolve TIMEOUT with
+    # no tokens; expired in-flight rows are frozen on device and resolve
+    # TIMEOUT with the tokens generated so far. 0 = no deadline
+    serve_deadline_s: float = 0.0
+    # tick-liveness watchdog (resilience/watchdog.py): abort with the
+    # resumable exit 76 when no scheduler tick completes for this long
+    # while work is in flight (a wedged decode dispatch). 0 = off
+    serve_watchdog_timeout_s: float = 0.0
+    # poison-request quarantine budget at submit/ingest: malformed samples
+    # (missing keys, wrong shape/dtype, num_node out of range) resolve
+    # FAILED and count against this budget; exhausting it raises
+    # DataErrorBudgetExceeded — a stream that is mostly poison is an
+    # upstream corruption event, not per-request noise
+    serve_poison_budget: int = 64
+    # bounded self-healing: how many times one engine may rebuild its slot
+    # pool after a device fault escapes the decode dispatch. Beyond the
+    # cap the fault propagates (the process is what needs restarting)
+    serve_max_rebuilds: int = 2
+    # per-request resubmission cap across rebuilds: an in-flight request
+    # interrupted by a device fault is re-queued at most this many times
+    # (tokens are only ever delivered at retirement — at-most-once per
+    # attempt), then resolves FAILED
+    serve_max_retries: int = 1
+    # stuck-slot reaper: an admitted row that has not retired within
+    # limit + this many extra ticks is frozen and resolved FAILED instead
+    # of wedging drain() forever
+    serve_reap_margin: int = 4
+    # --- training resilience follow-ups (ROADMAP) ---
+    # device-side liveness probe on the step watchdog: a tiny chained
+    # collective heartbeat runs on its own thread; if the device stops
+    # completing probes (a hang masked by the async dispatch queue) the
+    # watchdog trips even while host-side beats continue
+    watchdog_device_probe: bool = False
+    # step-granular rollback snapshots: refresh the guard's host snapshot
+    # every this many known-good iterations (taken at the guard-check
+    # cadence, so only states the guard has vetted are anchored), and
+    # replay from the snapshot's mid-epoch position instead of the whole
+    # epoch. 0 = epoch-granular snapshots (the PR-1 default)
+    snapshot_every_steps: int = 0
     # host-side input double-buffering depth (csat_tpu/train/loop.py:
     # prefetch_batches); 0 = synchronous
     prefetch: int = 2
@@ -296,6 +346,16 @@ class Config:
                 )
         assert self.serve_slots >= 1, self.serve_slots
         assert self.serve_prefill_budget >= 0, self.serve_prefill_budget
+        assert self.serve_max_queue >= 0, self.serve_max_queue
+        assert self.serve_queue_policy in ("reject", "shed_oldest"), (
+            self.serve_queue_policy)
+        assert self.serve_deadline_s >= 0, self.serve_deadline_s
+        assert self.serve_watchdog_timeout_s >= 0, self.serve_watchdog_timeout_s
+        assert self.serve_poison_budget >= 0, self.serve_poison_budget
+        assert self.serve_max_rebuilds >= 0, self.serve_max_rebuilds
+        assert self.serve_max_retries >= 0, self.serve_max_retries
+        assert self.serve_reap_margin >= 1, self.serve_reap_margin
+        assert self.snapshot_every_steps >= 0, self.snapshot_every_steps
         assert self.bucket_token_budget >= 0, self.bucket_token_budget
         assert all(n >= 1 for n in self.bucket_src_lens), self.bucket_src_lens
         assert all(t >= 2 for t in self.bucket_tgt_lens), (

@@ -22,6 +22,11 @@ lost last snapshots) instead of merely logging them:
   malformed data batches;
 * :mod:`~csat_tpu.resilience.faults` — a deterministic fault-injection
   harness so every behavior above is exercised by tier-1 CPU tests.
+
+The serving path (``csat_tpu/serve/engine.py``) reuses this toolkit:
+the tick-liveness watchdog, the quarantine error budget at submit, and
+the injector's serve-side faults (NaN logits, prefill failure, tick
+hang, wedged slot, decode fault) all come from here.
 """
 
 from csat_tpu.resilience.faults import CorruptBatchError, FaultInjector  # noqa: F401
@@ -33,4 +38,6 @@ from csat_tpu.resilience.preemption import (  # noqa: F401
     write_resume_marker,
 )
 from csat_tpu.resilience.retry import DataErrorBudgetExceeded, ErrorBudget, retry  # noqa: F401
-from csat_tpu.resilience.watchdog import EXIT_WATCHDOG, StepWatchdog  # noqa: F401
+from csat_tpu.resilience.watchdog import (  # noqa: F401
+    EXIT_WATCHDOG, StepWatchdog, device_liveness_probe,
+)

@@ -18,10 +18,31 @@ at a chosen step:
 * **failing saves** — a wrapper that makes the first N checkpoint saves
   raise, exercising the bounded retry.
 
+Serve-side faults (ISSUE 4): the :class:`~csat_tpu.serve.engine.ServeEngine`
+consults the injector at exact scheduler points, so every serving failure
+mode is reproducible on a chosen tick:
+
+* **NaN logits** — poison one slot's self-attention KV cache on a chosen
+  tick; the next decode step's logits for that row are non-finite,
+  exercising the engine's per-row retire-as-FAILED guard;
+* **prefill failure** — a chosen prefill call raises, standing in for a
+  device fault inside the admission program;
+* **tick hang** — a host stall inside :meth:`ServeEngine.tick`, the
+  wedged-dispatch mode the serve watchdog bounds;
+* **wedged slot** — silently freeze a slot's device row (limit → 0)
+  without telling the host scheduler: the row never retires, exercising
+  the stuck-slot reaper;
+* **decode fault** — the decode dispatch raises on a chosen tick,
+  exercising the bounded rebuild-and-resubmit path;
+* **poison sample** — :meth:`poison_sample` malforms a request payload in
+  a chosen way, exercising the submit-time quarantine.
+
 Step ordinals are global train-step attempts (0-based, counted by the
 Trainer across epochs within one ``fit`` call); batch ordinals count
-batches produced by the training iterator. Both are deterministic for a
-fixed config + corpus, which is what makes the tests assertions exact.
+batches produced by the training iterator; tick ordinals count engine
+ticks (0-based), prefill ordinals count prefill calls. All are
+deterministic for a fixed config + trace, which is what makes the tests
+assertions exact.
 """
 
 from __future__ import annotations
@@ -52,6 +73,11 @@ class FaultInjector:
         hang_seconds: float = 0.0,
         save_failures: int = 0,
         sleep: Callable[[float], None] = time.sleep,
+        serve_nan_logits: Collection[tuple] = (),
+        serve_prefill_fail_calls: Collection[int] = (),
+        serve_hang_at_tick: Optional[int] = None,
+        serve_wedge_slots: Collection[tuple] = (),
+        serve_decode_fail_ticks: Collection[int] = (),
     ) -> None:
         self.nan_loss_steps = frozenset(int(s) for s in nan_loss_steps)
         self.spike_steps = frozenset(int(s) for s in spike_steps)
@@ -65,6 +91,15 @@ class FaultInjector:
         self._sleep = sleep
         self._batch_ordinal = 0
         self.injected_saves_failed = 0
+        # serve faults: (tick, slot) pairs for cache poison / wedge, call
+        # ordinals for prefill failure, tick ordinals for decode failure
+        self.serve_nan_logits = {int(t): int(s) for t, s in serve_nan_logits}
+        self.serve_prefill_fail_calls = frozenset(
+            int(c) for c in serve_prefill_fail_calls)
+        self.serve_hang_at_tick = serve_hang_at_tick
+        self.serve_wedge_slots = {int(t): int(s) for t, s in serve_wedge_slots}
+        self.serve_decode_fail_ticks = frozenset(
+            int(t) for t in serve_decode_fail_ticks)
 
     # -- train-step faults -------------------------------------------------
 
@@ -93,6 +128,62 @@ class FaultInjector:
         else:
             handler.trigger()
         return True
+
+    # -- serve faults (consulted by ServeEngine.tick / _prefill_chunk) -----
+
+    def nan_logits_slot(self, tick: int) -> Optional[int]:
+        """Slot whose self-KV cache should be NaN-poisoned before this
+        tick's decode (None = no fault). The poison only reaches the
+        logits once the row attends to a poisoned cached position, i.e.
+        on rows with ``pos >= 1`` — inject after the row's first step."""
+        return self.serve_nan_logits.get(tick)
+
+    def wedge_slot(self, tick: int) -> Optional[int]:
+        """Slot whose device row should be silently frozen at this tick
+        (the host scheduler is NOT told — the row just stops retiring)."""
+        return self.serve_wedge_slots.get(tick)
+
+    def maybe_hang_tick(self, tick: int) -> None:
+        """Host stall inside the scheduler tick — the wedged-dispatch mode
+        the serve watchdog turns into a bounded outage."""
+        if self.serve_hang_at_tick is not None and tick == self.serve_hang_at_tick:
+            self._sleep(self.hang_seconds)
+
+    def maybe_fail_prefill(self, call_ordinal: int) -> None:
+        """Raise on the configured prefill call ordinals — a device fault
+        inside the admission program."""
+        if call_ordinal in self.serve_prefill_fail_calls:
+            raise RuntimeError(
+                f"injected prefill failure at call {call_ordinal}")
+
+    def maybe_fail_decode(self, tick: int) -> None:
+        """Raise on the configured decode ticks — a device fault escaping
+        the decode dispatch, exercising rebuild-and-resubmit."""
+        if tick in self.serve_decode_fail_ticks:
+            raise RuntimeError(f"injected decode fault at tick {tick}")
+
+    @staticmethod
+    def poison_sample(sample: dict, mode: str = "missing_key") -> dict:
+        """A malformed copy of a request sample: ``missing_key`` drops a
+        required field, ``oversize`` claims more nodes than max_src_len,
+        ``dtype`` turns token ids into floats, ``shape`` truncates the
+        source row — each a distinct way real traffic goes wrong."""
+        bad = dict(sample)
+        if mode == "missing_key":
+            bad.pop("L_raw")
+        elif mode == "oversize":
+            import numpy as np
+
+            bad["num_node"] = np.asarray(2 ** 14, np.int32)
+        elif mode == "dtype":
+            import numpy as np
+
+            bad["src_seq"] = np.asarray(bad["src_seq"], np.float32) + 0.5
+        elif mode == "shape":
+            bad["src_seq"] = bad["src_seq"][:-1]
+        else:
+            raise ValueError(f"unknown poison mode {mode!r}")
+        return bad
 
     # -- data faults -------------------------------------------------------
 

@@ -15,6 +15,15 @@ finalizers, which is exactly the state we are escaping.
 The loop disarms the watchdog around phases with legitimately different
 cadence (validation decodes, checkpoint drains, first-step compilation);
 the next beat re-arms it.
+
+Host beats track *host-observable* progress only: with async dispatch the
+host can keep enqueueing steps (and beating) for a while after the device
+has silently wedged — the queue masks the hang until it fills. The
+optional **device-side liveness probe** (``cfg.watchdog_device_probe``)
+closes that gap: a tiny chained-collective heartbeat
+(:func:`device_liveness_probe`) runs on its own thread and blocks until
+the device actually answers; if probes stop completing while the watchdog
+is armed, it trips even though host beats continue.
 """
 
 from __future__ import annotations
@@ -26,7 +35,7 @@ import threading
 import time
 from typing import Callable, Optional
 
-__all__ = ["EXIT_WATCHDOG", "StepWatchdog"]
+__all__ = ["EXIT_WATCHDOG", "StepWatchdog", "device_liveness_probe"]
 
 # sysexits EX_PROTOCOL is taken; 76 is conventionally free — distinct from
 # EXIT_PREEMPTED so supervisors can tell "hung hardware" from "preempted",
@@ -36,6 +45,30 @@ EXIT_WATCHDOG = 76
 
 def _default_abort() -> None:  # pragma: no cover - exits the process
     os._exit(EXIT_WATCHDOG)
+
+
+def device_liveness_probe(dtype=None):
+    """→ a zero-arg callable that round-trips a tiny chained collective
+    through every local device and blocks until it completes.
+
+    The psum chains all devices into one program, so ANY wedged chip
+    stalls the probe — which is exactly the signal: the probe thread stops
+    updating its completion time and the armed watchdog trips, even while
+    the async dispatch queue keeps absorbing host-side step submissions.
+    The payload is one scalar per device; at the watchdog's probe cadence
+    (seconds) the cost is unmeasurable.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    n = jax.local_device_count()
+    pulse = jax.pmap(lambda x: jax.lax.psum(x, "i"), axis_name="i")
+    x = jnp.ones((n,), jnp.float32 if dtype is None else dtype)
+
+    def probe() -> None:
+        jax.block_until_ready(pulse(x))
+
+    return probe
 
 
 class StepWatchdog:
@@ -53,6 +86,8 @@ class StepWatchdog:
         on_timeout: Optional[Callable[[], None]] = None,
         diag_path: Optional[str] = None,
         log: Callable[[str], None] = lambda m: print(m, file=sys.stderr),
+        probe: Optional[Callable[[], None]] = None,
+        probe_interval_s: Optional[float] = None,
     ) -> None:
         assert timeout_s > 0, timeout_s
         self.timeout_s = float(timeout_s)
@@ -65,6 +100,15 @@ class StepWatchdog:
         self._stop = threading.Event()
         self._tripped = threading.Event()
         self._thread: Optional[threading.Thread] = None
+        # optional device-side liveness probe (device_liveness_probe):
+        # runs on its own thread so a wedged device blocks the PROBE, not
+        # the monitor — the monitor just watches completion staleness
+        self._probe = probe
+        self._probe_interval = float(
+            probe_interval_s if probe_interval_s is not None
+            else max(0.05, self.timeout_s / 4.0))
+        self._last_probe = 0.0
+        self._probe_thread: Optional[threading.Thread] = None
 
     # -- lifecycle ---------------------------------------------------------
 
@@ -73,6 +117,11 @@ class StepWatchdog:
             self._thread = threading.Thread(
                 target=self._run, name="step-watchdog", daemon=True)
             self._thread.start()
+        if self._probe is not None and self._probe_thread is None:
+            self._last_probe = time.monotonic()  # grace until the 1st probe
+            self._probe_thread = threading.Thread(
+                target=self._run_probe, name="device-probe", daemon=True)
+            self._probe_thread.start()
         return self
 
     def stop(self) -> None:
@@ -80,6 +129,11 @@ class StepWatchdog:
         if self._thread is not None:
             self._thread.join(timeout=self.timeout_s)
             self._thread = None
+        if self._probe_thread is not None:
+            # a probe blocked on a wedged device never joins — it is a
+            # daemon thread, abandon it rather than hang shutdown
+            self._probe_thread.join(timeout=self._probe_interval)
+            self._probe_thread = None
 
     def __enter__(self) -> "StepWatchdog":
         return self.start()
@@ -111,14 +165,36 @@ class StepWatchdog:
         while not self._stop.wait(poll):
             with self._lock:
                 armed, last = self._armed, self._last_beat
-            if armed and time.monotonic() - last > self.timeout_s:
-                self._trip(time.monotonic() - last)
+                last_probe = self._last_probe
+            now = time.monotonic()
+            if not armed:
+                continue
+            if now - last > self.timeout_s:
+                self._trip(now - last, "no completed step")
+                return
+            # device leg: host beats can keep flowing while the device is
+            # wedged (the async dispatch queue absorbs submissions) — a
+            # stalled PROBE is the authoritative device-down signal. The
+            # window adds one probe interval so a probe in flight at the
+            # deadline is not a false positive.
+            if (self._probe is not None
+                    and now - last_probe > self.timeout_s + self._probe_interval):
+                self._trip(now - last_probe, "no completed device probe")
                 return
 
-    def _trip(self, stalled_s: float) -> None:
+    def _run_probe(self) -> None:
+        while not self._stop.wait(self._probe_interval):
+            try:
+                self._probe()
+            except Exception:  # noqa: BLE001 — a failing device must trip,
+                continue       # not crash the thread: staleness accumulates
+            with self._lock:
+                self._last_probe = time.monotonic()
+
+    def _trip(self, stalled_s: float, what: str = "no completed step") -> None:
         self._tripped.set()
         self._log(
-            f"# watchdog: no completed step for {stalled_s:.1f}s "
+            f"# watchdog: {what} for {stalled_s:.1f}s "
             f"(timeout {self.timeout_s:.1f}s) — dumping diagnostics and "
             "aborting with a resumable exit; the run can continue with "
             "fit(resume=True)")

@@ -1,13 +1,23 @@
 """Continuous-batching inference engine (slot-pooled KV cache, bucketed
-prefill, single compiled decode-step program).
+prefill, single compiled decode-step program) with a serving resilience
+layer: admission control + backpressure, per-request deadlines, poison
+quarantine at ingest, a NaN-logits guard, stuck-slot reaping, a
+tick-liveness watchdog, and bounded pool rebuild after device faults —
+every request ends in a structured :class:`RequestStatus`
+(``OK | FAILED | TIMEOUT | REJECTED | SHED``).
 
 Entry points: :class:`ServeEngine` (submit/poll/tick/drain),
 ``csat_tpu serve`` / ``csat_tpu summarize`` (serve/cli.py), and
 ``bench.py``'s ``:serve`` mode.
 """
 
-from csat_tpu.serve.engine import Request, ServeEngine  # noqa: F401
-from csat_tpu.serve.ingest import sample_from_dataset, sample_from_source  # noqa: F401
+from csat_tpu.serve.engine import Request, RequestStatus, ServeEngine  # noqa: F401
+from csat_tpu.serve.ingest import (  # noqa: F401
+    PoisonRequestError,
+    sample_from_dataset,
+    sample_from_source,
+    validate_sample,
+)
 from csat_tpu.serve.prefill import (  # noqa: F401
     PrefillSpec,
     assign_prefill_bucket,

@@ -14,6 +14,12 @@ through the L0/L1 extraction pipeline per request
   as JSON lines as they finish, interleaved with admission — the
   continuous-batching path exercised end to end.  EOF drains and exits.
 
+Serving resilience (ISSUE 4): every response carries a ``status``
+(``OK | FAILED | TIMEOUT | REJECTED | SHED`` — serve/engine.py); a
+malformed input line emits an error record and the loop continues;
+SIGTERM/SIGINT stops intake and drains gracefully, shedding whatever is
+still unfinished after ``--drain_deadline_s`` so shutdown is bounded.
+
 Examples::
 
     python -m csat_tpu.cli summarize --config python --data_dir ./processed \\
@@ -25,6 +31,7 @@ from __future__ import annotations
 
 import argparse
 import json
+import os
 import sys
 from typing import List, Optional
 
@@ -44,6 +51,18 @@ def _parser() -> argparse.ArgumentParser:
                    help="decode-slot pool size (default: config serve_slots)")
     p.add_argument("--max_new_tokens", type=int, default=0,
                    help="per-request decode budget (0 = max_tgt_len - 1)")
+    p.add_argument("--max_queue", type=int, default=-1,
+                   help="admission-control queue bound (0 = unbounded; "
+                        "default: config serve_max_queue)")
+    p.add_argument("--queue_policy", default="",
+                   help="reject | shed_oldest (default: config "
+                        "serve_queue_policy)")
+    p.add_argument("--deadline_s", type=float, default=-1.0,
+                   help="default per-request deadline in seconds "
+                        "(0 = none; default: config serve_deadline_s)")
+    p.add_argument("--drain_deadline_s", type=float, default=30.0,
+                   help="serve: on SIGTERM/SIGINT, drain in-flight work "
+                        "for at most this long before shedding the rest")
     p.add_argument("--platform", default="", help="force jax platform (cpu/tpu)")
     p.add_argument("--sep", default="\x00",
                    help="summarize stdin snippet separator (default NUL)")
@@ -61,8 +80,6 @@ def build_engine(args):
 
     enable_compilation_cache()
 
-    import os
-
     from csat_tpu.configs import get_config, list_configs
     from csat_tpu.data.vocab import Vocab, load_vocab
     from csat_tpu.serve.engine import ServeEngine
@@ -76,6 +93,12 @@ def build_engine(args):
         overrides["data_dir"] = args.data_dir
     if args.serve_slots:
         overrides["serve_slots"] = args.serve_slots
+    if getattr(args, "max_queue", -1) >= 0:
+        overrides["serve_max_queue"] = args.max_queue
+    if getattr(args, "queue_policy", ""):
+        overrides["serve_queue_policy"] = args.queue_policy
+    if getattr(args, "deadline_s", -1.0) >= 0:
+        overrides["serve_deadline_s"] = args.deadline_s
     cfg = get_config(args.config, **overrides)
 
     src_vocab, tgt_vocab = load_vocab(cfg.data_dir)
@@ -89,7 +112,8 @@ def build_engine(args):
     ckpt = args.checkpoint_dir or os.path.join(
         cfg.output_dir, cfg.project_name, cfg.task_name)
     params = restore_params(ckpt)
-    engine = ServeEngine(model, params, cfg, tgt_vocab=tgt_vocab)
+    engine = ServeEngine(model, params, cfg, tgt_vocab=tgt_vocab,
+                         log=lambda m: print(m, file=sys.stderr))
     return engine, cfg, src_vocab, trip_vocab
 
 
@@ -110,11 +134,15 @@ def _summarize(args) -> None:
         raw = sys.stdin.read()
         snippets = [s for s in raw.split(args.sep) if s.strip()]
         names = [f"stdin:{i}" for i in range(len(snippets))]
+    from csat_tpu.resilience.retry import DataErrorBudgetExceeded
+
     ids, errors = {}, {}
     for name, code in zip(names, snippets):
         try:
             ids[name] = _ingest(engine, cfg, src_vocab, trip_vocab, code,
                                 args.max_new_tokens)
+        except DataErrorBudgetExceeded:
+            raise  # mostly-poison input is an upstream corruption event
         except (SyntaxError, ValueError, RecursionError, RuntimeError) as e:
             errors[name] = f"{type(e).__name__}: {e}"
     engine.drain()
@@ -123,8 +151,20 @@ def _summarize(args) -> None:
             print(json.dumps({"source": name, "error": errors[name]}))
             continue
         req = engine.poll(ids[name])
+        if not req.ok:
+            # structured per-request outcome (REJECTED/TIMEOUT/FAILED/…) —
+            # an error record, not an exception killing the whole batch;
+            # partial tokens (in-flight TIMEOUT/SHED) ride along
+            rec = {"source": name, "status": req.status,
+                   "error": req.error or req.status}
+            if req.n_tokens:
+                rec.update(summary=" ".join(engine.words(req)),
+                           n_tokens=req.n_tokens)
+            print(json.dumps(rec))
+            continue
         print(json.dumps({
             "source": name,
+            "status": req.status,
             "summary": " ".join(engine.words(req)),
             "n_tokens": req.n_tokens,
         }))
@@ -134,8 +174,87 @@ def _summarize(args) -> None:
           file=sys.stderr)
 
 
+def _parse_request(line: str, n_anon: int):
+    """One stdin line → ``(ext_id, code, max_new_tokens_override, n_anon,
+    error)``.  Never raises: a malformed line (bad JSON handled by the
+    bare-string fallback; a non-object JSON value; a missing/non-string
+    ``code`` field) comes back as ``error`` so the serve loop emits one
+    error record and keeps going — one bad client must not take down the
+    stream."""
+    try:
+        rec = json.loads(line)
+    except json.JSONDecodeError:
+        rec = {"code": line.rstrip("\n")}
+    if isinstance(rec, str):
+        rec = {"code": rec}
+    if not isinstance(rec, dict):
+        return n_anon, None, None, n_anon + 1, (
+            f"request line must be a JSON object or a bare string, "
+            f"got {type(rec).__name__}")
+    ext_id = rec.get("id")
+    if ext_id is None:
+        ext_id = n_anon
+        n_anon += 1
+    code = rec.get("code")
+    if not isinstance(code, str):
+        return ext_id, None, None, n_anon, (
+            "missing or non-string 'code' field")
+    # None = field absent (server default applies); an EXPLICIT 0 means
+    # "full decode budget" (engine.submit semantics) and must survive
+    max_new = rec.get("max_new_tokens")
+    if max_new is not None:
+        try:
+            max_new = int(max_new)
+        except (TypeError, ValueError):
+            return ext_id, None, None, n_anon, "non-integer 'max_new_tokens'"
+    return ext_id, code, max_new, n_anon, None
+
+
+class _StdinLines:
+    """``select()``-safe line reader for the serve loop.
+
+    ``sys.stdin.readline()`` would pull a whole burst of lines into
+    Python's io buffer and return only the first — ``select()`` watches
+    the (now empty) OS pipe, so the buffered remainder would sit
+    invisible until the NEXT bytes arrive and the loop would wedge on a
+    bursty client.  This reader owns the buffering itself: one
+    ``os.read`` per readable select, then every complete line in the
+    buffer is handed back at once."""
+
+    def __init__(self, f):
+        self._fd = f.fileno()
+        self._buf = bytearray()
+        self.eof = False
+
+    def read_lines(self, timeout: float):
+        """→ every complete line available within ``timeout`` (possibly
+        empty); sets :attr:`eof` once the pipe closes."""
+        import select
+
+        if not self.eof:
+            readable, _, _ = select.select([self._fd], [], [], timeout)
+            if readable:
+                chunk = os.read(self._fd, 1 << 16)
+                if chunk == b"":
+                    self.eof = True
+                else:
+                    self._buf += chunk
+        lines = []
+        while True:
+            i = self._buf.find(b"\n")
+            if i < 0:
+                break
+            lines.append(self._buf[: i + 1].decode("utf-8", "replace"))
+            del self._buf[: i + 1]
+        if self.eof and self._buf:  # unterminated final line
+            lines.append(self._buf.decode("utf-8", "replace"))
+            self._buf.clear()
+        return lines
+
+
 def _serve(args) -> None:
-    import select
+    from csat_tpu.resilience.preemption import PreemptionHandler
+    from csat_tpu.resilience.retry import DataErrorBudgetExceeded
 
     engine, cfg, src_vocab, trip_vocab = build_engine(args)
 
@@ -143,53 +262,71 @@ def _serve(args) -> None:
         # pop_result keeps the engine's results map bounded over a long run
         for rid in [r for r in pending if engine.poll(r) is not None]:
             req = engine.pop_result(rid)
-            print(json.dumps({
-                "id": pending.pop(rid),
-                "summary": " ".join(engine.words(req)),
-                "n_tokens": req.n_tokens,
-                "latency_s": round(req.done_t - req.submit_t, 4),
-            }), flush=True)
+            rec = {"id": pending.pop(rid), "status": req.status}
+            if req.ok or req.n_tokens:
+                # in-flight TIMEOUT/SHED deliver the tokens decoded so far
+                # (the documented partial-result semantics), not just an error
+                rec.update(summary=" ".join(engine.words(req)),
+                           n_tokens=req.n_tokens)
+            if req.ok:
+                rec["latency_s"] = round(req.done_t - req.submit_t, 4)
+            else:
+                rec["error"] = req.error or req.status
+            print(json.dumps(rec), flush=True)
 
     pending: dict = {}
     n_anon = 0  # monotonic default ids — never reused across the run
+    stdin = _StdinLines(sys.stdin)
     eof = False
+    drain_deadline = None  # armed by SIGTERM/SIGINT
+    stop = PreemptionHandler()
     # event loop: while work is in flight, poll stdin without blocking and
     # keep ticking (a client that sends one request and then waits for the
-    # response must not deadlock on our next readline); when idle, block
-    # on stdin until the next request or EOF
-    while not eof or pending or engine.occupancy or engine.queue_depth:
-        busy = bool(pending or engine.occupancy or engine.queue_depth)
-        if not eof:
-            readable, _, _ = select.select([sys.stdin], [], [], 0.0 if busy else None)
-            if readable:
-                line = sys.stdin.readline()
-                if line == "":
-                    eof = True
-                elif line.strip():
-                    try:
-                        rec = json.loads(line)
-                    except json.JSONDecodeError:
-                        rec = {"code": line.rstrip("\n")}
-                    if isinstance(rec, str):
-                        rec = {"code": rec}
-                    ext_id = rec.get("id")
-                    if ext_id is None:
-                        ext_id = n_anon
-                        n_anon += 1
+    # response must not deadlock on our next read); when idle, wake at a
+    # bounded cadence (PEP 475 restarts select after a signal handler, so
+    # an indefinite block would sit through SIGTERM until the next line)
+    with stop.installed():
+        while not eof or pending or engine.occupancy or engine.queue_depth:
+            if stop.triggered and drain_deadline is None:
+                # graceful drain: stop intake, finish what is in flight,
+                # shed whatever remains at the deadline so exit is bounded
+                eof = True
+                drain_deadline = engine.clock() + max(args.drain_deadline_s, 0.0)
+                print(f"# serve: shutdown signal — draining "
+                      f"{len(pending)} request(s) for up to "
+                      f"{args.drain_deadline_s:.1f}s", file=sys.stderr)
+            if drain_deadline is not None and engine.clock() > drain_deadline:
+                engine.shed_all("graceful drain deadline expired")
+            busy = bool(pending or engine.occupancy or engine.queue_depth)
+            if not eof:
+                for line in stdin.read_lines(0.0 if busy else 0.2):
+                    if not line.strip():
+                        continue
+                    ext_id, code, max_new, n_anon, err = _parse_request(
+                        line, n_anon)
+                    if err is not None:
+                        print(json.dumps({"id": ext_id, "status": "FAILED",
+                                          "error": err}), flush=True)
+                        continue
                     try:
                         rid = _ingest(
-                            engine, cfg, src_vocab, trip_vocab, rec["code"],
-                            int(rec.get("max_new_tokens", args.max_new_tokens)))
+                            engine, cfg, src_vocab, trip_vocab, code,
+                            max_new if max_new is not None
+                            else args.max_new_tokens)
                         pending[rid] = ext_id
-                    except (KeyError, SyntaxError, ValueError, RecursionError,
+                    except DataErrorBudgetExceeded:
+                        raise  # poison budget spent — fail loud
+                    except (SyntaxError, ValueError, RecursionError,
                             RuntimeError) as e:
                         print(json.dumps(
-                            {"id": ext_id, "error": f"{type(e).__name__}: {e}"}),
+                            {"id": ext_id, "status": "FAILED",
+                             "error": f"{type(e).__name__}: {e}"}),
                             flush=True)
-                    continue  # favor draining the input burst before ticking
-        if engine.occupancy or engine.queue_depth:
-            engine.tick()
-        flush_finished(pending)
+                eof = eof or stdin.eof
+            if engine.occupancy or engine.queue_depth:
+                engine.tick()
+            flush_finished(pending)
+    engine.close()
     import jax
 
     print(json.dumps(engine.stats.summary(n_chips=jax.device_count())),

@@ -1,18 +1,33 @@
 """ServeEngine: continuous-batching scheduler over the slot pool.
 
 The engine owns a fixed pool of ``cfg.serve_slots`` decode slots
-(``serve/slots.py``), a FIFO request queue, and two kinds of compiled
-programs: ONE decode-step program advancing every live slot a token, and
-one bucketed prefill program per occupied encoder shape
+(``serve/slots.py``), a bounded FIFO request queue, and two kinds of
+compiled programs: ONE decode-step program advancing every live slot a
+token, and one bucketed prefill program per occupied encoder shape
 (``serve/prefill.py``).  Each :meth:`tick` is one scheduler round:
 
 1. **retire** — rows that emitted EOS or exhausted their token budget hand
-   their generated ids back to their request and free the slot;
-2. **admit** — freed slots refill from the queue head: requests group by
+   their generated ids back to their request and free the slot; rows whose
+   logits went non-finite retire FAILED instead of decoding garbage;
+2. **expire/reap** — queued and in-flight requests past their deadline
+   resolve TIMEOUT; admitted rows that stopped retiring (a wedged device
+   row) are frozen and resolve FAILED after a bounded grace;
+3. **admit** — freed slots refill from the queue head: requests group by
    smallest-fitting prefill bucket, each group runs the bucket's compiled
    encoder at its own (smaller) node capacity and scatters memory/cache
-   into the free slot rows;
-3. **decode** — the single decode-step program advances all live slots.
+   into the free slot rows; a prefill that raises resolves its chunk
+   FAILED with the pool still serving;
+4. **decode** — the single decode-step program advances all live slots; a
+   device fault escaping the dispatch triggers a bounded pool rebuild
+   with in-flight work resubmitted (at-most-once delivery per attempt).
+
+Every request reaches exactly one terminal :class:`RequestStatus`
+(``OK | FAILED | TIMEOUT | REJECTED | SHED``) — callers and the JSONL CLI
+report errors per request; no serving failure mode surfaces as an
+uncaught exception or a wedged slot (pinned by ``tests/test_serve.py``'s
+fault-drill matrix).  Admission control (``serve_max_queue`` +
+``serve_queue_policy``) bounds the queue so overload degrades into
+structured rejections/sheds instead of unbounded memory growth.
 
 Throughput therefore tracks *real* generated tokens, not bucket capacity:
 a short request never pays a long request's decode tail, and a freed slot
@@ -21,8 +36,8 @@ to finish.  At steady state nothing recompiles — the compile counter in
 ``ServeStats`` is the regression tripwire tests assert on.
 
 Host↔device contract: the pool pytree is donated through every program, so
-slot state lives in place on the device; the per-tick host work is two
-small ``(S,)`` fetches (done flags + positions) plus the queue bookkeeping.
+slot state lives in place on the device; the per-tick host work is one
+small ``(S, 3)`` status fetch plus the queue bookkeeping.
 """
 
 from __future__ import annotations
@@ -33,11 +48,15 @@ from collections import defaultdict, deque
 from typing import Any, Callable, Deque, Dict, List, Optional, Sequence
 
 import jax
+import jax.numpy as jnp
 import numpy as np
 
 from csat_tpu.configs import Config
 from csat_tpu.data.vocab import Vocab
 from csat_tpu.models import CSATrans
+from csat_tpu.resilience.retry import ErrorBudget
+from csat_tpu.resilience.watchdog import StepWatchdog
+from csat_tpu.serve.ingest import PoisonRequestError, validate_sample
 from csat_tpu.serve.prefill import (
     assign_prefill_bucket,
     build_prefill,
@@ -48,31 +67,54 @@ from csat_tpu.serve.slots import SlotPool, build_decode_step, init_pool
 from csat_tpu.serve.stats import ServeStats
 from csat_tpu.utils import EOS_WORD
 
-__all__ = ["Request", "ServeEngine"]
+__all__ = ["Request", "RequestStatus", "ServeEngine"]
+
+
+class RequestStatus:
+    """Terminal request outcomes (str constants, JSON-friendly)."""
+
+    PENDING = "PENDING"    # queued or in flight — the only non-terminal state
+    OK = "OK"              # tokens delivered (EOS or budget)
+    FAILED = "FAILED"      # poison input, NaN logits, stuck slot, device fault
+    TIMEOUT = "TIMEOUT"    # deadline expired (queued: no tokens; in-flight: partial)
+    REJECTED = "REJECTED"  # admission control refused it (queue full, "reject")
+    SHED = "SHED"          # dropped to make room ("shed_oldest") or at drain deadline
+
+    TERMINAL = (OK, FAILED, TIMEOUT, REJECTED, SHED)
 
 
 @dataclasses.dataclass
 class Request:
     """One queued/in-flight/finished summarization request.
 
-    ``sample`` is released at retirement (the (N, N) relation matrices are
-    the payload's bulk and are only needed until prefill); ``tokens`` and
-    the timestamps survive."""
+    ``sample`` is released at the terminal transition (the (N, N) relation
+    matrices are the payload's bulk and are only needed until prefill —
+    but they must survive *while in flight* so a pool rebuild can
+    resubmit); ``tokens`` and the timestamps survive."""
 
     id: int
     sample: Optional[Dict[str, np.ndarray]]  # flagship-width arrays (serve/ingest.py)
     limit: int                      # decode-token budget (<= steps)
     submit_t: float
+    deadline_t: Optional[float] = None  # absolute clock deadline (None = none)
     admit_t: Optional[float] = None
     done_t: Optional[float] = None
     slot: Optional[int] = None
     bucket: Optional[int] = None    # prefill bucket index it was admitted at
     tokens: Optional[np.ndarray] = None  # generated ids incl. the EOS, if any
     n_tokens: int = 0
+    status: str = RequestStatus.PENDING
+    error: Optional[str] = None     # human-readable cause for non-OK outcomes
+    attempts: int = 0               # resubmissions consumed by pool rebuilds
+    admit_tick: Optional[int] = None  # engine tick at admission (reaper clock)
 
     @property
     def finished(self) -> bool:
         return self.done_t is not None
+
+    @property
+    def ok(self) -> bool:
+        return self.status == RequestStatus.OK
 
 
 class ServeEngine:
@@ -86,29 +128,47 @@ class ServeEngine:
         tgt_vocab: Optional[Vocab] = None,
         clock: Callable[[], float] = time.monotonic,
         sample_seed: int = 0,
+        fault_injector: Any = None,
+        watchdog_on_timeout: Optional[Callable[[], None]] = None,
+        log: Callable[[str], None] = lambda m: None,
     ):
         self.model = model
         self.params = params
         self.cfg = cfg
         self.tgt_vocab = tgt_vocab
         self.clock = clock
+        self.log = log
         self.steps = cfg.max_tgt_len - 1
         self.num_slots = cfg.serve_slots
         self.specs = prefill_plan(cfg)
         self.stats = ServeStats(self.num_slots)
         self.stats.started_t = clock()
+        # deterministic fault drills (resilience/faults.py serve hooks)
+        self.fault_injector = fault_injector
 
         self._pool: SlotPool = init_pool(
             model, {"params": params}, self.num_slots, self.steps, cfg.max_src_len)
         self._slots: List[Optional[Request]] = [None] * self.num_slots
         self._queue: Deque[Request] = deque()
         self._results: Dict[int, Request] = {}
-        # host mirror of the last decode step's (S, 2) [pos, done] snapshot
-        # — the only per-tick device→host read besides retired token rows
+        # host mirror of the last decode step's (S, 3) [pos, done, bad]
+        # snapshot — the only per-tick device→host read besides retired
+        # token rows
         self._status: Optional[np.ndarray] = None
         self._next_id = 0
         self._n_prefills = 0
+        self._tick_no = 0
+        self._rebuilds = 0
+        # set once any deadlined request is ever submitted: the per-tick
+        # queue scan for expiry is O(queue depth) and must stay off the
+        # no-deadline hot path (a deep backlog pays it per generated token)
+        self._has_deadlines = False
         self._base_key = jax.random.key(cfg.seed + sample_seed)
+        # poison-request quarantine at ingest: same budgeted policy as the
+        # training data pipeline (PR 1) — each refused sample is a
+        # structured FAILED outcome; exhausting the budget raises, because
+        # a mostly-poison stream is upstream corruption, not noise
+        self._poison_budget = ErrorBudget(cfg.serve_poison_budget, log=log)
 
         # the ONE decode-step program, AOT-compiled up front (pool donated:
         # slot state advances in place, no per-step copies)
@@ -116,18 +176,89 @@ class ServeEngine:
         self._decode_prog = step.lower(self.params, self._pool).compile()
         self.stats.record_compile("decode", (self.num_slots, self.steps))
         self._prefill_progs: Dict[int, Any] = {}
+        # tiny host-side row surgery, shape-stable and jitted once each —
+        # NOT counted as serving programs (the compile tripwire is about
+        # the decode/prefill hot path)
+        # donated: every unchanged leaf (the whole KV cache) aliases its
+        # input buffer, so a freeze touches only the (S,) limit vector
+        # instead of copying the pool
+        self._freeze_prog = jax.jit(
+            lambda pool, keep: pool._replace(
+                limit=jnp.where(keep, pool.limit, 0)),
+            donate_argnums=(0,))
+        self._nan_prog = None  # built lazily, fault drills only
+
+        # tick-liveness watchdog: the serving analogue of the step
+        # watchdog — beats once per completed tick while work is in
+        # flight, disarms when idle, and by default aborts with the
+        # resumable exit 76 so a supervisor restarts the server
+        self._watchdog: Optional[StepWatchdog] = None
+        if cfg.serve_watchdog_timeout_s > 0:
+            self._watchdog = StepWatchdog(
+                cfg.serve_watchdog_timeout_s,
+                on_timeout=watchdog_on_timeout,
+                log=log).start()
+
+    def close(self) -> None:
+        """Stop background machinery (the watchdog thread). Idempotent."""
+        if self._watchdog is not None:
+            self._watchdog.stop()
+            self._watchdog = None
 
     # ---------------- public API ----------------
 
-    def submit(self, sample: Dict[str, np.ndarray], max_new_tokens: int = 0) -> int:
-        """Queue one request; returns its id.  ``max_new_tokens`` caps the
-        decode budget (0 = the full ``max_tgt_len - 1`` steps; generation
-        stops earlier at the first EOS either way)."""
+    def submit(
+        self,
+        sample: Dict[str, np.ndarray],
+        max_new_tokens: int = 0,
+        deadline_s: Optional[float] = None,
+    ) -> int:
+        """Queue one request; returns its id — ALWAYS, even when the
+        request is refused: admission control and the poison quarantine
+        resolve it to a terminal REJECTED/SHED/FAILED result immediately,
+        so callers see one uniform poll-the-outcome contract instead of
+        exceptions.  ``max_new_tokens`` caps the decode budget (0 = the
+        full ``max_tgt_len - 1`` steps; generation stops earlier at the
+        first EOS either way).  ``deadline_s`` (seconds from now; None =
+        ``cfg.serve_deadline_s``, 0 = none) bounds the request's total
+        latency.
+
+        The only exception path is budget exhaustion: a stream whose
+        poison count exceeds ``cfg.serve_poison_budget`` raises
+        :class:`~csat_tpu.resilience.retry.DataErrorBudgetExceeded`."""
+        now = self.clock()
         limit = self.steps if max_new_tokens <= 0 else min(max_new_tokens, self.steps)
+        ddl = self.cfg.serve_deadline_s if deadline_s is None else deadline_s
         req = Request(
-            id=self._next_id, sample=sample, limit=limit, submit_t=self.clock())
+            id=self._next_id, sample=sample, limit=limit, submit_t=now,
+            deadline_t=(now + ddl) if ddl and ddl > 0 else None)
         self._next_id += 1
         self.stats.submitted += 1
+        if req.deadline_t is not None:
+            self._has_deadlines = True
+
+        # poison quarantine: fail fast HERE, not inside a compiled prefill
+        try:
+            validate_sample(sample, self.cfg, self.model.src_vocab_size)
+        except PoisonRequestError as e:
+            # raises DataErrorBudgetExceeded once the budget is spent
+            self._poison_budget([req.id], e)
+            self.stats.quarantined = self._poison_budget.count
+            self._finish(req, RequestStatus.FAILED,
+                         error=f"poison request: {e}", now=now)
+            return req.id
+
+        # admission control: bounded queue with a structured outcome
+        max_q = self.cfg.serve_max_queue
+        if max_q and len(self._queue) >= max_q:
+            if self.cfg.serve_queue_policy == "reject":
+                self._finish(req, RequestStatus.REJECTED,
+                             error=f"queue full ({max_q})", now=now)
+                return req.id
+            shed = self._queue.popleft()  # shed_oldest: freshest work wins
+            self._finish(shed, RequestStatus.SHED,
+                         error=f"shed by admission control (queue {max_q})",
+                         now=now)
         self._queue.append(req)
         return req.id
 
@@ -142,29 +273,106 @@ class ServeEngine:
         return self._results.pop(req_id, None)
 
     def tick(self) -> int:
-        """One scheduler round (retire → admit → decode); returns the number
-        of slots still live afterwards."""
+        """One scheduler round (retire → expire/reap → admit → decode);
+        returns the number of slots still live afterwards."""
+        tick = self._tick_no
+        self._tick_no += 1
+        if self._watchdog is not None and (
+                self._queue or any(r is not None for r in self._slots)):
+            # arm BEFORE the dispatch work: a tick that wedges inside the
+            # decode program (including the very first tick after idle)
+            # must trip — the end-of-tick beat alone would leave a
+            # first-tick hang unmonitored forever
+            self._watchdog.beat()
+        try:
+            live = self._tick_body(tick)
+        except BaseException:
+            # a fatal fault propagating to the caller (rebuild cap, drain
+            # bound) must not leave the watchdog armed with no future
+            # beats — it would os._exit the process timeout_s later, out
+            # from under the caller's own error handling
+            if self._watchdog is not None:
+                self._watchdog.disarm()
+            raise
+        if self._watchdog is not None:
+            if live or self._queue:
+                self._watchdog.beat()
+            else:
+                self._watchdog.disarm()  # idle is not a hang
+        return live
+
+    def _tick_body(self, tick: int) -> int:
+        inj = self.fault_injector
+        if inj is not None:
+            inj.maybe_hang_tick(tick)
+            wedge = inj.wedge_slot(tick)
+            if wedge is not None:
+                # silently freeze the device row — the host scheduler is
+                # NOT told, so only the reaper can recover the request
+                self._freeze_rows([wedge])
         self._retire()
+        self._expire_and_reap()
         self._admit()
         live = sum(r is not None for r in self._slots)
         if live:
-            self._pool, status = self._decode_prog(self.params, self._pool)
-            self._status = np.asarray(status)
-            self.stats.decode_steps += 1
+            try:
+                if inj is not None:
+                    slot = inj.nan_logits_slot(tick)
+                    if slot is not None:
+                        self._inject_nan(slot)
+                    inj.maybe_fail_decode(tick)
+                self._pool, status = self._decode_prog(self.params, self._pool)
+                self._status = np.asarray(status)
+                self.stats.decode_steps += 1
+            except Exception as e:  # noqa: BLE001 — device fault: self-heal
+                self._rebuild_and_resubmit(e)
+                live = 0
         return live
 
     def drain(self, max_ticks: int = 0) -> Dict[int, Request]:
-        """Run ticks until queue and pool are empty; returns all results."""
-        max_ticks = max_ticks or (len(self._queue) + self.num_slots + 1) * (self.steps + 2)
+        """Run ticks until queue and pool are empty; returns all results.
+
+        The stuck-slot reaper guarantees progress (a non-retiring row is
+        force-failed within ``limit + serve_reap_margin`` ticks of
+        admission), so the tick bound below is a belt-and-braces backstop
+        for scheduler bugs, not the recovery path."""
+        max_ticks = max_ticks or (len(self._queue) + self.num_slots + 1) * (
+            self.steps + self.cfg.serve_reap_margin + 2)
         ticks = 0
         while self._queue or any(r is not None for r in self._slots):
             self.tick()
             ticks += 1
             if ticks > max_ticks:
+                if self._watchdog is not None:
+                    self._watchdog.disarm()  # see tick(): no beats follow
                 raise RuntimeError(
                     f"drain exceeded {max_ticks} ticks — a slot is not retiring")
         self._retire()  # collect rows finished by the final decode step
+        if self._watchdog is not None:
+            self._watchdog.disarm()
         return self._results
+
+    def shed_all(self, reason: str = "graceful drain deadline") -> int:
+        """Resolve every queued AND in-flight request as SHED (partial
+        tokens for in-flight rows) — the graceful-shutdown escape hatch
+        when the drain deadline expires.  Returns the number shed."""
+        now = self.clock()
+        n = 0
+        while self._queue:
+            self._finish(self._queue.popleft(), RequestStatus.SHED,
+                         error=reason, now=now)
+            n += 1
+        freeze = []
+        for i, req in enumerate(self._slots):
+            if req is None:
+                continue
+            freeze.append(i)
+            self._finish_slot(i, RequestStatus.SHED, error=reason, now=now)
+            n += 1
+        self._freeze_rows(freeze)
+        if self._watchdog is not None:
+            self._watchdog.disarm()
+        return n
 
     def words(self, req: Request) -> List[str]:
         """Detokenized summary, truncated at the first EOS (the metric
@@ -193,25 +401,149 @@ class ServeEngine:
 
     # ---------------- scheduler internals ----------------
 
+    def _finish(self, req: Request, status: str, error: Optional[str] = None,
+                now: Optional[float] = None) -> None:
+        """One-way transition to a terminal outcome: timestamps, payload
+        release, result publication, outcome counters."""
+        assert status in RequestStatus.TERMINAL, status
+        now = self.clock() if now is None else now
+        req.status = status
+        req.error = error
+        req.done_t = now
+        req.sample = None  # release the (N, N) payload
+        if status == RequestStatus.OK:
+            self.stats.record_request(req.submit_t, req.admit_t, now, req.n_tokens)
+        else:
+            self.stats.record_outcome(status)
+            if error:
+                self.log(f"# serve: request {req.id} {status}: {error}")
+        self._results[req.id] = req
+
+    def _finish_slot(self, i: int, status: str, error: Optional[str] = None,
+                     now: Optional[float] = None,
+                     drop_last_token: bool = False) -> None:
+        """Terminal transition for an IN-FLIGHT request: deliver the
+        partial tokens decoded so far (from the last status snapshot) and
+        free the slot.  ``drop_last_token`` discards the newest token —
+        the NaN-logits retire path, where that token is argmax of garbage."""
+        req = self._slots[i]
+        assert req is not None
+        pos = 0
+        if self._status is not None:
+            pos = int(self._status[i, 0])
+        if drop_last_token:
+            pos = max(pos - 1, 0)
+        if pos > 0:
+            toks = np.asarray(self._pool.toks)
+            req.n_tokens = pos
+            req.tokens = np.array(toks[i, :pos])
+        self._slots[i] = None
+        self._finish(req, status, error=error, now=now)
+
+    def _freeze_rows(self, slots: Sequence[int]) -> None:
+        """Zero the device-side budget of ``slots`` so the decode program
+        treats them as frozen (act = pos < limit fails) — the host-side
+        half is the caller's job.  One shape-stable jitted call."""
+        if not len(slots):
+            return
+        keep = np.ones((self.num_slots,), bool)
+        keep[list(slots)] = False
+        self._pool = self._freeze_prog(self._pool, keep)
+
+    def _inject_nan(self, slot: int) -> None:
+        """Fault drill: NaN-poison one slot's self-attention KV cache so
+        the next decode step's logits for that row are non-finite — the
+        realistic on-device corruption the logits guard exists for."""
+        if self._nan_prog is None:
+            def poison(pool: SlotPool, mask):
+                m = mask[:, None, None, None]
+                cache = {
+                    layer: {
+                        "self": {
+                            "k": jnp.where(m, jnp.nan, entry["self"]["k"]),
+                            "v": jnp.where(m, jnp.nan, entry["self"]["v"]),
+                        },
+                        "cross": entry["cross"],
+                    }
+                    for layer, entry in pool.cache.items()
+                }
+                return pool._replace(cache=cache)
+
+            self._nan_prog = jax.jit(poison)
+        mask = np.zeros((self.num_slots,), bool)
+        mask[slot] = True
+        self._pool = self._nan_prog(self._pool, mask)
+
     def _retire(self) -> None:
         if self._status is None or not any(r is not None for r in self._slots):
             return
         pos = self._status[:, 0]
         done = self._status[:, 1]
+        bad = self._status[:, 2]
         toks = None
         now = self.clock()
+        # non-finite logits: the newest token is argmax of garbage — retire
+        # the rows FAILED with their clean prefixes instead of decoding
+        # noise until budget. One batched freeze call, not one per row.
+        bad_rows = [i for i, req in enumerate(self._slots)
+                    if req is not None and bad[i]]
+        if bad_rows:
+            self._freeze_rows(bad_rows)
+            for i in bad_rows:
+                self._finish_slot(
+                    i, RequestStatus.FAILED,
+                    error="non-finite logits during decode", now=now,
+                    drop_last_token=True)
         for i, req in enumerate(self._slots):
-            if req is None or not (done[i] or pos[i] >= req.limit):
+            if req is None:
+                continue
+            if not (done[i] or pos[i] >= req.limit):
                 continue
             if toks is None:
                 toks = np.asarray(self._pool.toks)
             req.n_tokens = int(pos[i])
             req.tokens = np.array(toks[i, : req.n_tokens])
-            req.done_t = now
-            req.sample = None  # release the (N, N) payload — prefill is done
-            self.stats.record_request(req.submit_t, req.admit_t, now, req.n_tokens)
-            self._results[req.id] = req
             self._slots[i] = None
+            self._finish(req, RequestStatus.OK, now=now)
+
+    def _expire_and_reap(self) -> None:
+        """Deadline expiry (queued + in-flight) and stuck-slot reaping."""
+        now = self.clock()
+        if self._has_deadlines and self._queue and any(
+                r.deadline_t is not None and now > r.deadline_t
+                for r in self._queue):
+            keep: Deque[Request] = deque()
+            for req in self._queue:
+                if req.deadline_t is not None and now > req.deadline_t:
+                    self._finish(req, RequestStatus.TIMEOUT,
+                                 error="deadline expired in queue", now=now)
+                else:
+                    keep.append(req)
+            self._queue = keep
+        freeze = []
+        for i, req in enumerate(self._slots):
+            if req is None:
+                continue
+            if req.deadline_t is not None and now > req.deadline_t:
+                freeze.append(i)
+                self._finish_slot(
+                    i, RequestStatus.TIMEOUT,
+                    error="deadline expired in flight", now=now)
+                continue
+            # reaper: a healthy row retires within `limit` decode ticks of
+            # admission; past limit + margin the row is wedged (device
+            # anomaly, lost status) — force-fail it so drain() and the
+            # pool keep moving
+            if (req.admit_tick is not None
+                    and self._tick_no - req.admit_tick
+                    > req.limit + self.cfg.serve_reap_margin):
+                freeze.append(i)
+                self.stats.reaped += 1
+                self._finish_slot(
+                    i, RequestStatus.FAILED,
+                    error=f"stuck slot reaped after "
+                          f"{self._tick_no - req.admit_tick} ticks", now=now)
+        self._freeze_rows(freeze)
 
     def _admit(self) -> None:
         free = [i for i, r in enumerate(self._slots) if r is None]
@@ -226,12 +558,41 @@ class ServeEngine:
             groups[k].append(req)
         # deterministic admission order: buckets ascending, FIFO within a
         # bucket, slots assigned in ascending index order
-        for k in sorted(groups):
-            pending = groups[k]
-            while pending:
-                chunk = pending[: self.specs[k].batch_size]
-                pending = pending[len(chunk):]
-                self._prefill_chunk(k, chunk, [free.pop(0) for _ in chunk])
+        order = [req for k in sorted(groups) for req in groups[k]]
+        while order:
+            k = order[0].bucket
+            chunk: List[Request] = []
+            while (order and order[0].bucket == k
+                    and len(chunk) < self.specs[k].batch_size):
+                chunk.append(order.pop(0))
+            slot_ids = [free.pop(0) for _ in chunk]
+            try:
+                self._prefill_chunk(k, chunk, slot_ids)
+            except Exception as e:  # noqa: BLE001 — admission program fault
+                now = self.clock()
+                for req in chunk:
+                    self._finish(
+                        req, RequestStatus.FAILED,
+                        error=f"prefill failed: {type(e).__name__}: {e}",
+                        now=now)
+                if getattr(self._pool.pos, "is_deleted", lambda: False)():
+                    # the fault hit AFTER the pool was donated into the
+                    # dispatch: every slot's state is gone, not just the
+                    # chunk's. Put the not-yet-admitted window back at the
+                    # queue head (rebuild then prepends the in-flight
+                    # survivors in front, preserving global FIFO) and
+                    # rebuild — freezing rows on a deleted pool would be
+                    # the secondary crash that escapes tick()
+                    self._queue.extendleft(reversed(order))
+                    self._rebuild_and_resubmit(e)
+                    return
+                # fault before dispatch consumed the buffers (collate,
+                # validation, injected pre-dispatch failure): the pool is
+                # intact — the chunk resolves FAILED, its slots return to
+                # the free list, and the pool keeps serving
+                self._freeze_rows(slot_ids)
+                free = slot_ids + free
+                free.sort()
 
     def _prefill_chunk(self, k: int, chunk: List[Request], slot_ids: List[int]) -> None:
         spec = self.specs[k]
@@ -243,7 +604,10 @@ class ServeEngine:
         limits = np.zeros((spec.batch_size,), np.int32)
         limits[: len(chunk)] = [r.limit for r in chunk]
         key = jax.random.fold_in(self._base_key, self._n_prefills)
+        call_ordinal = self._n_prefills
         self._n_prefills += 1
+        if self.fault_injector is not None:
+            self.fault_injector.maybe_fail_prefill(call_ordinal)
         prog = self._prefill_progs.get(k)
         if prog is None:
             fn = jax.jit(build_prefill(self.model, spec), donate_argnums=(5,))
@@ -257,7 +621,49 @@ class ServeEngine:
         for req, s in zip(chunk, slot_ids):
             req.admit_t = now
             req.slot = s
+            req.admit_tick = self._tick_no
             self._slots[s] = req
+
+    def _rebuild_and_resubmit(self, exc: BaseException) -> None:
+        """Self-healing after a device fault escaped the decode dispatch:
+        discard the (now undefined) pool, re-init a fresh one at the same
+        shapes — the AOT decode and prefill programs are shape-keyed, so
+        they carry over with ZERO recompiles — and resubmit in-flight work
+        at the queue head in original order.  Tokens are only ever
+        delivered at the terminal transition, so resubmission is
+        at-most-once per attempt; a request past ``serve_max_retries``
+        resolves FAILED, and an engine past ``serve_max_rebuilds``
+        re-raises (the process itself needs restarting)."""
+        if self._rebuilds >= self.cfg.serve_max_rebuilds:
+            raise RuntimeError(
+                f"device fault after {self._rebuilds} rebuilds "
+                f"(serve_max_rebuilds={self.cfg.serve_max_rebuilds}): "
+                f"{type(exc).__name__}: {exc}") from exc
+        self._rebuilds += 1
+        self.stats.rebuilds += 1
+        inflight = [r for r in self._slots if r is not None]
+        self.log(f"# serve: device fault ({type(exc).__name__}: {exc}) — "
+                 f"rebuild #{self._rebuilds}, resubmitting "
+                 f"{len(inflight)} in-flight request(s)")
+        self._slots = [None] * self.num_slots
+        self._status = None
+        self._pool = init_pool(
+            self.model, {"params": self.params}, self.num_slots, self.steps,
+            self.cfg.max_src_len)
+        now = self.clock()
+        survivors = []
+        for req in sorted(inflight, key=lambda r: r.id):
+            req.attempts += 1
+            req.slot = req.bucket = req.admit_t = req.admit_tick = None
+            if req.attempts > self.cfg.serve_max_retries:
+                self._finish(
+                    req, RequestStatus.FAILED,
+                    error=f"device fault, retries exhausted "
+                          f"({req.attempts - 1} resubmissions): "
+                          f"{type(exc).__name__}: {exc}", now=now)
+            else:
+                survivors.append(req)
+        self._queue.extendleft(reversed(survivors))  # FIFO order preserved
 
     # ---------------- conveniences ----------------
 

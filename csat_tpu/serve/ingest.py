@@ -33,7 +33,86 @@ from csat_tpu.data.extract import source_to_ast_json
 from csat_tpu.data.vocab import Vocab
 from csat_tpu.utils import UNK
 
-__all__ = ["sample_from_source", "sample_from_dataset"]
+__all__ = [
+    "PoisonRequestError",
+    "sample_from_source",
+    "sample_from_dataset",
+    "validate_sample",
+]
+
+
+class PoisonRequestError(ValueError):
+    """A request sample that would crash (or silently corrupt) the engine
+    downstream: missing fields, wrong shape/dtype, out-of-range node count
+    or token ids.  Raised at submit/ingest time so the failure is a
+    structured per-request outcome, not an exception (or garbage gather)
+    inside a compiled prefill program."""
+
+
+# field → (required ndim, integer-kind dtype check). Shapes are validated
+# against the config below; tree_pos is uint8 but np.unsignedinteger is a
+# subclass of np.integer, so one kind check covers every field.
+_SAMPLE_FIELDS = {
+    "src_seq": 1,
+    "L_raw": 2,
+    "T_raw": 2,
+    "num_node": 0,
+    "tree_pos": 2,
+    "triplet": 1,
+}
+
+
+def validate_sample(
+    sample: Dict[str, np.ndarray],
+    cfg: Config,
+    src_vocab_size: int = 0,
+) -> None:
+    """Fail fast on a malformed request sample (:class:`PoisonRequestError`).
+
+    Checks the exact contract ``collate_requests`` and the compiled
+    prefill/scatter programs assume: required keys, flagship-width shapes,
+    integer dtypes, ``1 <= num_node <= max_src_len``, and non-negative
+    token ids bounded by the source vocab (out-of-table ids would gather
+    with jnp's silent clip semantics — a wrong answer, not an error).
+    """
+    if not isinstance(sample, dict):
+        raise PoisonRequestError(
+            f"sample must be a dict of arrays, got {type(sample).__name__}")
+    missing = [k for k in _SAMPLE_FIELDS if k not in sample]
+    if missing:
+        raise PoisonRequestError(f"sample missing required keys {missing}")
+    N = cfg.max_src_len
+    tp_dim = cfg.tree_pos_width * cfg.tree_pos_height
+    want_shape = {
+        "src_seq": (N,), "L_raw": (N, N), "T_raw": (N, N), "num_node": (),
+        "tree_pos": (N, tp_dim), "triplet": (N,),
+    }
+    for key, ndim in _SAMPLE_FIELDS.items():
+        try:
+            arr = np.asarray(sample[key])
+        except Exception as e:  # ragged lists, objects — not an array
+            raise PoisonRequestError(f"sample[{key!r}] is not array-like: "
+                                     f"{type(e).__name__}: {e}") from e
+        if arr.ndim != ndim or arr.shape != want_shape[key]:
+            raise PoisonRequestError(
+                f"sample[{key!r}] has shape {arr.shape}, expected "
+                f"{want_shape[key]} (flagship width, serve/ingest.py)")
+        if not np.issubdtype(arr.dtype, np.integer):
+            raise PoisonRequestError(
+                f"sample[{key!r}] has dtype {arr.dtype}, expected an "
+                "integer dtype")
+    n = int(np.asarray(sample["num_node"]))
+    if not 1 <= n <= N:
+        raise PoisonRequestError(
+            f"num_node={n} outside [1, max_src_len={N}] — oversized inputs "
+            "must be truncated at ingest (truncate_preorder), not submitted")
+    src = np.asarray(sample["src_seq"])
+    if src.min() < 0:
+        raise PoisonRequestError("src_seq contains negative token ids")
+    if src_vocab_size and src.max() >= src_vocab_size:
+        raise PoisonRequestError(
+            f"src_seq token id {int(src.max())} >= src vocab size "
+            f"{src_vocab_size} (OOV ids must map to <unk> at ingest)")
 
 
 def sample_from_source(

@@ -80,11 +80,17 @@ def build_decode_step(model: CSATrans):
     one token.  Pure and shape-stable — the engine AOT-compiles it exactly
     once (donating the pool) and dispatches the same executable forever.
 
-    ``status`` is a packed ``(S, 2)`` int32 ``[pos, done]`` snapshot — the
-    scheduler's entire per-tick host read in ONE device→host transfer
+    ``status`` is a packed ``(S, 3)`` int32 ``[pos, done, bad]`` snapshot —
+    the scheduler's entire per-tick host read in ONE device→host transfer
     (fetching ``pool.pos`` and ``pool.done`` separately would double the
     per-token sync cost, which is the engine's main overhead over the
-    lockstep scan).
+    lockstep scan).  ``bad`` flags an active row whose logits contained a
+    NaN/Inf this step: its argmax token is garbage, so the engine retires
+    the row FAILED (discarding the poisoned token) instead of decoding
+    garbage until budget — the serving analogue of the trainer's in-step
+    non-finite guard (resilience/guards.py).  The check is one
+    ``isfinite`` reduction over the (S, V) logits, negligible next to the
+    decode matmuls.
     """
 
     def step(params, pool: SlotPool):
@@ -103,6 +109,9 @@ def build_decode_step(model: CSATrans):
         )
         nxt = jnp.argmax(log_probs, axis=-1).astype(jnp.int32)  # (S,)
         act = (~pool.done) & (pool.pos < pool.limit)
+        # per-row non-finite-logits verdict, only meaningful on active rows
+        # (frozen rows flow dead state through the math by design)
+        bad = act & jnp.any(~jnp.isfinite(log_probs), axis=-1)
         nxt = jnp.where(act, nxt, PAD)
 
         t_cap = pool.toks.shape[1]
@@ -133,7 +142,8 @@ def build_decode_step(model: CSATrans):
             cache=cache_out, src_mask=pool.src_mask, tok=tok, pos=pos,
             limit=pool.limit, done=done, prev_pad=prev_pad, toks=toks,
         )
-        status = jnp.stack([pos, done.astype(jnp.int32)], axis=1)
+        status = jnp.stack(
+            [pos, done.astype(jnp.int32), bad.astype(jnp.int32)], axis=1)
         return new_pool, status
 
     return step

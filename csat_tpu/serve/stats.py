@@ -39,7 +39,16 @@ class ServeStats:
         self.compile_events: List[Tuple[str, Tuple]] = []
         self.submitted = 0
         self.admitted = 0
-        self.retired = 0
+        self.retired = 0         # OK retirements (tokens delivered)
+        # structured non-OK outcomes (serve/engine.py resilience layer)
+        self.rejected = 0        # queue-full, policy "reject"
+        self.shed = 0            # queue-full shed_oldest / graceful-drain shed
+        self.timeouts = 0        # per-request deadline expiry
+        self.failed = 0          # NaN logits, stuck slot, prefill/device
+                                 # fault, poison submit — every FAILED outcome
+        self.quarantined = 0     # poison subset of `failed` (submit-time)
+        self.reaped = 0          # stuck slots force-retired by the reaper
+        self.rebuilds = 0        # slot-pool rebuilds after a device fault
         self.decode_steps = 0      # engine ticks that ran the decode program
         self.prefill_calls = 0
         self.gen_tokens = 0        # real tokens delivered to finished requests
@@ -68,6 +77,14 @@ class ServeStats:
             self.first_done_t = done_t
         self.last_done_t = done_t
 
+    def record_outcome(self, status: str) -> None:
+        """Count one non-OK terminal outcome (``RequestStatus`` value) —
+        latency percentiles stay OK-only so failure storms cannot make the
+        service look faster than it is."""
+        field = {"REJECTED": "rejected", "SHED": "shed",
+                 "TIMEOUT": "timeouts", "FAILED": "failed"}[status]
+        setattr(self, field, getattr(self, field) + 1)
+
     # ---------------- reporting ----------------
 
     def summary(self, wall_s: Optional[float] = None, n_chips: int = 1) -> Dict[str, float]:
@@ -83,6 +100,13 @@ class ServeStats:
             "submitted": self.submitted,
             "admitted": self.admitted,
             "retired": self.retired,
+            "rejected": self.rejected,
+            "shed": self.shed,
+            "timeouts": self.timeouts,
+            "failed": self.failed,
+            "quarantined": self.quarantined,
+            "reaped": self.reaped,
+            "rebuilds": self.rebuilds,
             "decode_steps": self.decode_steps,
             "prefill_calls": self.prefill_calls,
             "compiles": self.compiles,

@@ -693,6 +693,7 @@ class Trainer:
         history: Dict[str, Any] = {
             "loss": [], "val_bleu": [], "best_bleu": best_bleu,
             "rollbacks": 0, "nonfinite_steps": 0, "quarantined": 0,
+            "step_snapshots": 0,
         }
         if cfg.bucketing:
             history["bucket_programs"] = self._warm_bucket_programs(
@@ -715,20 +716,41 @@ class Trainer:
                 stack.enter_context(preempt.installed())
             watchdog = None
             if cfg.watchdog_timeout_s > 0:
+                probe = None
+                if cfg.watchdog_device_probe:
+                    # device-side liveness leg (ROADMAP follow-up): host
+                    # beats keep flowing while the async dispatch queue
+                    # absorbs submissions to a wedged device — the chained
+                    # collective probe blocks until the device answers.
+                    # Run it once here so the pmap compile cannot
+                    # masquerade as staleness on the first armed window.
+                    from csat_tpu.resilience.watchdog import (
+                        device_liveness_probe,
+                    )
+
+                    probe = device_liveness_probe()
+                    probe()
                 watchdog = stack.enter_context(StepWatchdog(
                     cfg.watchdog_timeout_s,
                     on_timeout=self.watchdog_on_timeout,
                     diag_path=os.path.join(
                         self.output_dir, "watchdog_diagnostics.txt"),
-                    log=self.log))
+                    log=self.log,
+                    probe=probe))
             for epoch in range(start_epoch, num_epochs + 1):
                 if preempt.triggered:
                     # signal arrived between epochs (validation/checkpoint
                     # phase): snapshot at the epoch boundary
                     self._preempt_save(ck_dir, state, epoch, 0)
                     raise Preempted(ck_dir, epoch, 0)
-                # rollback anchor: the last state known good at a sync point
+                # rollback anchor: the last state known good at a sync
+                # point. With cfg.snapshot_every_steps the anchor is
+                # refreshed mid-epoch at the guard-check cadence (below),
+                # and snap_it records which iteration position the anchor
+                # corresponds to, so a rollback replays only the window
+                # since the snapshot instead of the whole epoch
                 snapshot = host_snapshot(state) if rollback_after else None
+                snap_it = skip_iterations if epoch == start_epoch else 0
                 if cfg.profile and epoch == start_epoch:
                     # one profiled epoch: the jax.profiler trace is the TPU
                     # analogue of the reference's torch.cuda.Event harness
@@ -736,6 +758,12 @@ class Trainer:
                     jax.profiler.start_trace(os.path.join(self.output_dir, "trace"))
                 t0 = time.time()
                 skip = skip_iterations if epoch == start_epoch else 0
+                # loss accumulators captured WITH each rollback anchor: a
+                # narrowed replay (snapshot_every_steps) resumes the epoch
+                # sums from the snapshot position, so history['loss'] stays
+                # a full-epoch mean, not a replayed-window mean
+                snap_loss = (jnp.zeros((), jnp.float32),
+                             jnp.zeros((), jnp.float32))
                 while True:
                     # one epoch ATTEMPT: a guard rollback abandons the
                     # attempt and replays the whole epoch from the restored
@@ -751,8 +779,7 @@ class Trainer:
                     # (the old per-step `losses` list pinned every loss
                     # scalar until the epoch-end nanmean), and the epoch-end
                     # host sync shrinks to two scalars
-                    loss_sum = jnp.zeros((), jnp.float32)
-                    loss_cnt = jnp.zeros((), jnp.float32)
+                    loss_sum, loss_cnt = snap_loss
                     last_loss = None
                     rolled_back = False
                     batches: Iterable[Batch] = self._train_batches(
@@ -807,6 +834,26 @@ class Trainer:
                                 self.log(
                                     f"guard: non-finite step skipped (epoch "
                                     f"{epoch} it {it}; {bad} consecutive)")
+                            elif (rollback_after and cfg.snapshot_every_steps
+                                    and it_done - snap_it
+                                    >= cfg.snapshot_every_steps):
+                                # distance-based, not modulo: guard checks
+                                # land at it_done = k·guard_check_every + 1,
+                                # so a modulo test could NEVER fire for
+                                # aligned cadences (e.g. both 16) — refresh
+                                # whenever ≥ N iterations passed since the
+                                # current anchor, at whatever check lands
+                                # first
+                                # step-granular anchor refresh (ROADMAP
+                                # follow-up): only at the guard-check
+                                # cadence and only when the counter says
+                                # the state is good — anchoring a state
+                                # the guard has not vetted would roll
+                                # back INTO the divergence
+                                snapshot = host_snapshot(state)
+                                snap_it = it_done
+                                snap_loss = (loss_sum, loss_cnt)
+                                history["step_snapshots"] += 1
                             if rollback_after and bad >= rollback_after:
                                 if history["rollbacks"] >= cfg.guard_max_rollbacks:
                                     raise TrainingDivergedError(
@@ -818,12 +865,17 @@ class Trainer:
                                     snapshot, resplit=history["rollbacks"])
                                 bad_dev = None
                                 rolled_back = True
+                                # replay from the snapshot's position: the
+                                # whole epoch when the anchor is the epoch
+                                # start, only the since-snapshot window
+                                # under snapshot_every_steps
+                                skip = snap_it
                                 self.log(
                                     f"guard: rollback #{history['rollbacks']} — "
                                     f"{bad} consecutive non-finite steps at "
                                     f"epoch {epoch} it {it}; restored the "
-                                    "epoch-start snapshot with a re-split rng; "
-                                    "replaying the epoch")
+                                    f"snapshot at iteration {snap_it} with a "
+                                    "re-split rng; replaying from there")
                                 break
                     if not rolled_back:
                         break

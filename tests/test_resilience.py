@@ -16,7 +16,8 @@ import pytest
 from csat_tpu.data.dataset import ASTDataset, iterate_batches
 from csat_tpu.resilience import (
     CorruptBatchError, DataErrorBudgetExceeded, ErrorBudget, FaultInjector,
-    Preempted, PreemptionHandler, StepWatchdog, TrainingDivergedError, retry,
+    Preempted, PreemptionHandler, StepWatchdog, TrainingDivergedError,
+    device_liveness_probe, retry,
 )
 from csat_tpu.train import Trainer
 from csat_tpu.train.checkpoint import make_checkpoint_fn
@@ -148,6 +149,42 @@ def test_watchdog_unit_trip_and_disarm(tmp_path):
         assert not ev2.wait(0.8), "disarmed watchdog tripped"
 
 
+def test_device_liveness_probe_completes():
+    """The chained-collective heartbeat round-trips all 8 virtual devices
+    and returns — the healthy-device baseline of the probe leg."""
+    probe = device_liveness_probe()
+    probe()
+    probe()
+
+
+def test_watchdog_device_probe_leg_trips_despite_beats():
+    """The hang the host leg cannot see: host beats keep arriving (the
+    async dispatch queue absorbs submissions) while the DEVICE stops
+    answering probes — the probe-staleness leg must trip anyway. A
+    healthy probe under the same beat pattern must not."""
+    import time as _time
+
+    ev = threading.Event()
+    with StepWatchdog(0.4, on_timeout=ev.set, log=lambda m: None,
+                      probe=lambda: _time.sleep(60),
+                      probe_interval_s=0.05) as wd:
+        deadline = _time.monotonic() + 3.0
+        while _time.monotonic() < deadline and not ev.is_set():
+            wd.beat()  # host-side progress never stops
+            _time.sleep(0.05)
+        assert ev.is_set(), "stalled device probe did not trip the watchdog"
+        assert wd.tripped
+
+    ev2 = threading.Event()
+    with StepWatchdog(0.4, on_timeout=ev2.set, log=lambda m: None,
+                      probe=lambda: None, probe_interval_s=0.05) as wd2:
+        end = _time.monotonic() + 1.0
+        while _time.monotonic() < end:
+            wd2.beat()
+            _time.sleep(0.05)
+        assert not ev2.is_set(), "healthy probe tripped the watchdog"
+
+
 def test_watchdog_trips_on_hung_step(rig):
     """An injected mid-epoch stall (the hung-RPC stand-in) trips the
     watchdog within its timeout; training then continues once the hang
@@ -165,6 +202,52 @@ def test_watchdog_trips_on_hung_step(rig):
     assert os.path.exists(
         os.path.join(trainer.output_dir, "watchdog_diagnostics.txt"))
     assert np.isfinite(hist["loss"][0])
+
+
+# --------------------------------------------------------------------------
+# step-granular rollback snapshots + device-probe knob (ROADMAP follow-ups)
+# --------------------------------------------------------------------------
+
+
+def test_step_granular_snapshot_narrows_replay_window(
+        synthetic_corpus, micro_config, tmp_path_factory):
+    """With ``snapshot_every_steps=4`` the rollback anchor refreshes at the
+    guard-check cadence and a rollback replays only the window since the
+    last good snapshot, not the whole epoch. The tripwire: a spike planted
+    at global step 18 would fire under whole-epoch replay (8 + 12 = 20
+    step attempts) but is NEVER reached under the narrowed replay
+    (8 + 8 = 16 attempts) — so exactly the two injected NaNs show up.
+    Also exercises ``watchdog_device_probe=True`` end to end on the
+    virtual 8-device mesh."""
+    cfg = micro_config.replace(
+        data_dir=synthetic_corpus, full_att=True, num_epochs=1,
+        val_interval=99, save_interval=99,
+        guard_rollback_after=2, guard_max_rollbacks=2, guard_check_every=1,
+        snapshot_every_steps=4,
+        # generous timeout: the first TWO steps compile (~12s each on this
+        # box — the initial state is uncommitted, the first step's output
+        # is mesh-committed, so pjit builds a second program) and the
+        # host-leg must not false-positive on a known recompile
+        watchdog_timeout_s=30.0, watchdog_device_probe=True,
+        output_dir=str(tmp_path_factory.mktemp("step_snap")),
+    )
+    trainer = Trainer(cfg, log=lambda s: None)
+    tripped = threading.Event()
+    trainer.watchdog_on_timeout = tripped.set
+    trainer.fault_injector = FaultInjector(
+        nan_loss_steps=(6, 7), spike_steps=(18,))
+    state, hist = trainer.fit(
+        ASTDataset(cfg, "train", trainer.src_vocab, trainer.tgt_vocab), None)
+    assert hist["rollbacks"] == 1
+    # 2, not 3: step 18 was never executed — the replay started at the
+    # iteration-4 snapshot instead of the epoch start
+    assert hist["nonfinite_steps"] == 2
+    # snapshots at it_done 4 (attempt 1) and 8, 12 (narrowed replay)
+    assert hist["step_snapshots"] == 3
+    # restored step-4 anchor + 8 replayed steps: the full 12-batch epoch
+    assert int(state.step) == 12
+    assert np.isfinite(hist["loss"][0])
+    assert not tripped.is_set(), "healthy run tripped the device-probe watchdog"
 
 
 # --------------------------------------------------------------------------

@@ -14,19 +14,31 @@ Pins the engine's four contracts:
   trace adds zero compiles (the serving-regression tripwire);
 * **throughput** (slow) — on a skewed-length Poisson trace the engine
   moves more generated tokens per second than batch-at-a-time
-  ``greedy_decode`` over the same requests.
+  ``greedy_decode`` over the same requests;
+* **resilience** (ISSUE 4) — the fault-drill matrix: every injected serve
+  fault (queue overflow, deadline expiry, poison input, NaN logits,
+  wedged slot, prefill failure, device fault, tick hang) ends in a
+  structured per-request outcome with the pool still serving — no
+  uncaught exception, no wedged slot — and fault-free requests stay
+  bit-identical to a fresh ``greedy_decode``.
 """
+
+import threading
 
 import jax
 import numpy as np
 import pytest
 
 from csat_tpu.data.toy import random_request_sample
+from csat_tpu.resilience import DataErrorBudgetExceeded, ErrorBudget, FaultInjector
 from csat_tpu.serve import (
+    PoisonRequestError,
+    RequestStatus,
     ServeEngine,
     assign_prefill_bucket,
     collate_requests,
     prefill_plan,
+    validate_sample,
 )
 from csat_tpu.utils import EOS
 
@@ -325,3 +337,402 @@ def test_nocache_forward_is_cached_per_model(served):
     # and the cached-forward path still agrees with the KV-cache decoder
     ref = _fresh_decode(cfg, model, params, sample)
     np.testing.assert_array_equal(a[0], ref)
+
+
+# ---------------------------------------------------------------------------
+# serving resilience: the fault-drill matrix (ISSUE 4 tentpole)
+# ---------------------------------------------------------------------------
+
+
+class FakeClock:
+    """Manually-advanced clock for deadline drills (the engine's ``clock``
+    is injectable precisely for this)."""
+
+    def __init__(self):
+        self.t = 0.0
+
+    def __call__(self) -> float:
+        return self.t
+
+    def advance(self, dt: float) -> None:
+        self.t += dt
+
+
+@pytest.fixture(scope="module")
+def drilled(served):
+    """A dedicated engine over the SAME model/params as ``served`` (so the
+    fresh-decode references compare apples to apples), with a fake clock
+    and a recording tick watchdog. Tests mutate ``engine.cfg`` /
+    ``engine.fault_injector`` for their scenario and leave the pool
+    drained."""
+    cfg0, model, params, _ = served
+    cfg = cfg0.replace(serve_watchdog_timeout_s=3.0)
+    clock = FakeClock()
+    tripped = threading.Event()
+    eng = ServeEngine(model, params, cfg, clock=clock,
+                      watchdog_on_timeout=tripped.set)
+    yield cfg, model, params, eng, clock, tripped
+    eng.close()
+
+
+def _drill_reset(eng, cfg) -> None:
+    """Between-scenario hygiene on the shared drill engine."""
+    assert eng.occupancy == 0 and eng.queue_depth == 0
+    eng.cfg = cfg
+    eng.fault_injector = None
+    eng._rebuilds = 0
+
+
+def _bucket0_requests(cfg, n, seed):
+    """Same-bucket (<= 24 node) requests: deterministic admission maps the
+    i-th submitted request to slot i, which the targeted drills rely on."""
+    return [
+        random_request_sample(cfg, SRC_V, TRIP_V, 5 + (i % 12), seed=7000 * seed + i)
+        for i in range(n)
+    ]
+
+
+def test_validate_sample_catches_each_poison_mode(serve_cfg):
+    good = random_request_sample(serve_cfg, SRC_V, TRIP_V, 8, seed=0)
+    validate_sample(good, serve_cfg, SRC_V)  # clean sample passes
+    for mode in ("missing_key", "oversize", "dtype", "shape"):
+        with pytest.raises(PoisonRequestError):
+            validate_sample(
+                FaultInjector.poison_sample(good, mode), serve_cfg, SRC_V)
+    with pytest.raises(PoisonRequestError):
+        validate_sample({"src_seq": good["src_seq"]}, serve_cfg, SRC_V)
+    oov = dict(good)
+    oov["src_seq"] = np.where(
+        good["src_seq"] > 0, SRC_V + 5, good["src_seq"]).astype(np.int32)
+    with pytest.raises(PoisonRequestError):
+        validate_sample(oov, serve_cfg, SRC_V)
+
+
+def test_poison_submit_quarantined_under_budget(drilled):
+    """A malformed submit resolves FAILED (structured, no exception) and
+    counts against the quarantine budget; exhausting the budget raises —
+    a mostly-poison stream is upstream corruption. Clean traffic before,
+    between and after the poison keeps serving."""
+    cfg, model, params, eng, clock, _ = drilled
+    _drill_reset(eng, cfg)
+    old_budget = eng._poison_budget
+    eng._poison_budget = ErrorBudget(2, log=lambda m: None)
+    try:
+        good = _bucket0_requests(cfg, 2, seed=1)
+        bad = FaultInjector.poison_sample(good[0], "missing_key")
+        rid_bad = eng.submit(bad)
+        req = eng.poll(rid_bad)
+        assert req is not None and req.status == RequestStatus.FAILED
+        assert "poison request" in req.error
+        assert eng.stats.quarantined == 1
+
+        rid_bad2 = eng.submit(FaultInjector.poison_sample(good[0], "dtype"))
+        assert eng.poll(rid_bad2).status == RequestStatus.FAILED
+        with pytest.raises(DataErrorBudgetExceeded):
+            eng.submit(FaultInjector.poison_sample(good[0], "oversize"))
+
+        reqs = eng.generate(good, max_new_tokens=3)  # pool still serving
+        assert all(r.status == RequestStatus.OK for r in reqs)
+    finally:
+        eng._poison_budget = old_budget
+
+
+def test_queue_full_reject_and_shed_policies(drilled):
+    """Admission control: a bounded queue resolves overflow as REJECTED
+    (reject) or sheds the oldest queued request (shed_oldest) — submit
+    never grows the queue beyond the bound and never raises."""
+    cfg, model, params, eng, clock, _ = drilled
+    _drill_reset(eng, cfg.replace(serve_max_queue=2))
+    samples = _bucket0_requests(cfg, 5, seed=2)
+    ids = [eng.submit(s, max_new_tokens=2) for s in samples[:3]]
+    assert eng.queue_depth == 2
+    rej = eng.poll(ids[2])
+    assert rej.status == RequestStatus.REJECTED and "queue full" in rej.error
+    assert eng.stats.rejected >= 1
+
+    eng.cfg = cfg.replace(serve_max_queue=2, serve_queue_policy="shed_oldest")
+    id3 = eng.submit(samples[3], max_new_tokens=2)
+    assert eng.queue_depth == 2  # bounded: oldest went out, newest came in
+    shed = eng.poll(ids[0])
+    assert shed.status == RequestStatus.SHED and eng.stats.shed >= 1
+    eng.drain()
+    for rid, sample in ((ids[1], samples[1]), (id3, samples[3])):
+        req = eng.poll(rid)
+        assert req.status == RequestStatus.OK
+        np.testing.assert_array_equal(
+            np.asarray(req.tokens),
+            _fresh_decode(cfg, model, params, sample)[: req.n_tokens])
+    _drill_reset(eng, cfg)
+
+
+def test_deadline_timeout_queued_and_in_flight(drilled):
+    """Deadline expiry is a structured TIMEOUT: a queued request resolves
+    with no tokens, an in-flight request resolves with the tokens decoded
+    so far and its slot frees for the next request."""
+    cfg, model, params, eng, clock, _ = drilled
+    _drill_reset(eng, cfg)
+    samples = _bucket0_requests(cfg, 2, seed=3)
+
+    # queued expiry: never ticked between submit and deadline
+    rid = eng.submit(samples[0], max_new_tokens=5, deadline_s=4.0)
+    clock.advance(10.0)
+    eng.tick()
+    req = eng.poll(rid)
+    assert req.status == RequestStatus.TIMEOUT and req.n_tokens == 0
+    assert "queue" in req.error
+    assert eng.occupancy == 0  # expired before admission
+
+    # in-flight expiry: admit, decode a couple of ticks, then expire
+    rid = eng.submit(samples[1], max_new_tokens=8, deadline_s=4.0)
+    eng.tick()  # admit + first decode
+    eng.tick()
+    clock.advance(10.0)
+    eng.tick()
+    req = eng.poll(rid)
+    assert req.status == RequestStatus.TIMEOUT and "in flight" in req.error
+    assert 0 < req.n_tokens <= 8  # partial tokens delivered
+    np.testing.assert_array_equal(
+        np.asarray(req.tokens),
+        _fresh_decode(cfg, model, params, samples[1])[: req.n_tokens])
+    assert eng.occupancy == 0 and eng.stats.timeouts == 2
+    # the freed slot serves the next request normally
+    nxt = eng.generate(_bucket0_requests(cfg, 1, seed=4), max_new_tokens=2)[0]
+    assert nxt.status == RequestStatus.OK
+
+
+def test_nan_logits_retire_row_failed_others_exact(drilled):
+    """NaN-poisoned KV cache on one slot: that row retires FAILED with the
+    clean token prefix (the poisoned argmax is dropped), every other
+    in-flight request stays bit-identical to a fresh greedy_decode, and
+    the slot serves subsequent requests."""
+    cfg, model, params, eng, clock, _ = drilled
+    _drill_reset(eng, cfg)
+    t0 = eng._tick_no
+    eng.fault_injector = FaultInjector(serve_nan_logits=[(t0 + 1, 0)])
+    samples = _bucket0_requests(cfg, cfg.serve_slots, seed=5)
+    ids = [eng.submit(s, max_new_tokens=6) for s in samples]
+    eng.drain()
+    eng.fault_injector = None
+    victim = eng.poll(ids[0])
+    assert victim.status == RequestStatus.FAILED
+    assert "non-finite logits" in victim.error
+    assert victim.n_tokens == 1  # poisoned at pos 1: one clean token kept
+    ref0 = _fresh_decode(cfg, model, params, samples[0])
+    np.testing.assert_array_equal(np.asarray(victim.tokens), ref0[:1])
+    for rid, sample in list(zip(ids, samples))[1:]:
+        req = eng.poll(rid)
+        assert req.status == RequestStatus.OK
+        np.testing.assert_array_equal(
+            np.asarray(req.tokens),
+            _fresh_decode(cfg, model, params, sample)[: req.n_tokens])
+    assert eng.stats.failed >= 1
+    # the poisoned slot is clean after re-prefill: resubmit the victim
+    retry = eng.generate([samples[0]], max_new_tokens=6)[0]
+    assert retry.status == RequestStatus.OK
+    np.testing.assert_array_equal(np.asarray(retry.tokens), ref0[: retry.n_tokens])
+
+
+def test_stuck_slot_reaped_not_wedged(drilled):
+    """A silently wedged device row (limit zeroed behind the scheduler's
+    back) is reaped FAILED within limit + serve_reap_margin ticks —
+    drain() completes instead of raising, and the pool keeps serving."""
+    cfg, model, params, eng, clock, _ = drilled
+    _drill_reset(eng, cfg)
+    t0 = eng._tick_no
+    eng.fault_injector = FaultInjector(serve_wedge_slots=[(t0 + 1, 0)])
+    samples = _bucket0_requests(cfg, cfg.serve_slots, seed=6)
+    ids = [eng.submit(s, max_new_tokens=4) for s in samples]
+    eng.drain()  # must terminate: the reaper, not the tick bound
+    eng.fault_injector = None
+    victim = eng.poll(ids[0])
+    assert victim.status == RequestStatus.FAILED
+    assert "stuck slot reaped" in victim.error
+    assert eng.stats.reaped == 1
+    for rid, sample in list(zip(ids, samples))[1:]:
+        req = eng.poll(rid)
+        assert req.status == RequestStatus.OK
+        np.testing.assert_array_equal(
+            np.asarray(req.tokens),
+            _fresh_decode(cfg, model, params, sample)[: req.n_tokens])
+    assert eng.generate(_bucket0_requests(cfg, 1, seed=7),
+                        max_new_tokens=2)[0].status == RequestStatus.OK
+
+
+def test_prefill_failure_fails_chunk_pool_still_serving(drilled):
+    """An admission-program fault resolves its whole chunk FAILED; the
+    slots return to the free list and later admissions succeed."""
+    cfg, model, params, eng, clock, _ = drilled
+    _drill_reset(eng, cfg)
+    p0 = eng._n_prefills
+    eng.fault_injector = FaultInjector(serve_prefill_fail_calls=[p0])
+    samples = _bucket0_requests(cfg, 2, seed=8)
+    ids = [eng.submit(s, max_new_tokens=3) for s in samples]
+    eng.drain()
+    eng.fault_injector = None
+    spec0 = eng.specs[0]
+    # the first prefill call carries min(batch, both) requests — every
+    # request in that chunk FAILED, anything after it succeeded
+    n_failed = min(spec0.batch_size, 2)
+    statuses = [eng.poll(r).status for r in ids]
+    assert statuses[:n_failed] == [RequestStatus.FAILED] * n_failed
+    assert all(s == RequestStatus.OK for s in statuses[n_failed:])
+    assert "prefill failed" in eng.poll(ids[0]).error
+    reqs = eng.generate(samples, max_new_tokens=3)  # same samples now serve
+    assert all(r.status == RequestStatus.OK for r in reqs)
+
+
+def test_device_fault_rebuilds_and_resubmits_bit_identical(drilled):
+    """A device fault escaping the decode dispatch: the engine rebuilds
+    the pool (zero new compiles — programs are shape-keyed), resubmits
+    in-flight work at the queue head, and every request still resolves OK
+    with tokens bit-identical to a fresh greedy_decode (at-most-once
+    delivery per attempt: nothing is emitted twice)."""
+    cfg, model, params, eng, clock, _ = drilled
+    _drill_reset(eng, cfg)
+    t0 = eng._tick_no
+    compiles0 = eng.stats.compiles
+    eng.fault_injector = FaultInjector(serve_decode_fail_ticks=[t0 + 1])
+    samples = _bucket0_requests(cfg, cfg.serve_slots + 2, seed=9)
+    ids = [eng.submit(s, max_new_tokens=4) for s in samples]
+    eng.drain()
+    eng.fault_injector = None
+    assert eng.stats.rebuilds == 1
+    assert eng.stats.compiles == compiles0, "rebuild must not recompile"
+    for rid, sample in zip(ids, samples):
+        req = eng.poll(rid)
+        assert req.status == RequestStatus.OK
+        np.testing.assert_array_equal(
+            np.asarray(req.tokens),
+            _fresh_decode(cfg, model, params, sample)[: req.n_tokens])
+    # the first pool's occupants were interrupted once
+    assert any(eng.poll(r).attempts == 1 for r in ids)
+
+
+def test_device_fault_retries_exhausted_then_cap(drilled):
+    """Retries are bounded per request (FAILED once exhausted) and
+    rebuilds are bounded per engine (the fault propagates past the cap) —
+    and the engine still serves clean traffic afterwards."""
+    cfg, model, params, eng, clock, _ = drilled
+    _drill_reset(eng, cfg.replace(serve_max_retries=0, serve_max_rebuilds=4))
+    t0 = eng._tick_no
+    eng.fault_injector = FaultInjector(serve_decode_fail_ticks=[t0])
+    samples = _bucket0_requests(cfg, 2, seed=10)
+    ids = [eng.submit(s, max_new_tokens=3) for s in samples]
+    eng.drain()
+    eng.fault_injector = None
+    for rid in ids:
+        req = eng.poll(rid)
+        assert req.status == RequestStatus.FAILED
+        assert "retries exhausted" in req.error
+
+    # rebuild cap: past serve_max_rebuilds the fault propagates loud
+    _drill_reset(eng, cfg.replace(serve_max_rebuilds=0))
+    t0 = eng._tick_no
+    eng.fault_injector = FaultInjector(serve_decode_fail_ticks=[t0])
+    eng.submit(samples[0], max_new_tokens=3)
+    with pytest.raises(RuntimeError, match="serve_max_rebuilds"):
+        eng.drain()
+    eng.fault_injector = None
+    eng._rebuilds = 0
+    eng.drain()  # the un-faulted retry completes cleanly
+    assert eng.occupancy == 0 and eng.queue_depth == 0
+    reqs = eng.generate(samples, max_new_tokens=3)
+    assert all(r.status == RequestStatus.OK for r in reqs)
+    _drill_reset(eng, cfg)
+
+
+def test_shed_all_resolves_everything(drilled):
+    """The graceful-shutdown escape hatch: queued AND in-flight requests
+    resolve SHED (partial tokens for in-flight) and the pool empties."""
+    cfg, model, params, eng, clock, _ = drilled
+    _drill_reset(eng, cfg)
+    samples = _bucket0_requests(cfg, cfg.serve_slots + 2, seed=11)
+    ids = [eng.submit(s, max_new_tokens=8) for s in samples]
+    eng.tick()
+    eng.tick()
+    n = eng.shed_all("drill")
+    assert n == len(samples)
+    assert eng.occupancy == 0 and eng.queue_depth == 0
+    statuses = {eng.poll(r).status for r in ids}
+    assert statuses == {RequestStatus.SHED}
+    assert any(eng.poll(r).n_tokens > 0 for r in ids[: cfg.serve_slots])
+    assert eng.generate(samples[:1], max_new_tokens=2)[0].status == RequestStatus.OK
+
+
+def test_cli_parse_request_hardened():
+    """The JSONL loop's line parser never raises: malformed lines come
+    back as error records (satellite: one bad client must not take down
+    the stream). Previously a bare JSON number crashed the loop with an
+    uncaught AttributeError."""
+    from csat_tpu.serve.cli import _parse_request
+
+    ext, code, mx, n, err = _parse_request(
+        '{"id": "a", "code": "x", "max_new_tokens": 3}\n', 0)
+    assert (ext, code, mx, n, err) == ("a", "x", 3, 0, None)
+
+    ext, code, mx, n, err = _parse_request("def f(): pass\n", 0)
+    assert err is None and code == "def f(): pass" and ext == 0 and n == 1
+
+    ext, code, mx, n, err = _parse_request('"just a string"\n', 5)
+    assert err is None and code == "just a string" and ext == 5 and n == 6
+
+    _, code, _, _, err = _parse_request("42\n", 0)
+    assert code is None and "JSON object" in err
+
+    ext, code, _, _, err = _parse_request('{"id": 7}\n', 0)
+    assert ext == 7 and code is None and "code" in err
+
+    _, _, _, _, err = _parse_request(
+        '{"code": "x", "max_new_tokens": "lots"}\n', 0)
+    assert "max_new_tokens" in err
+
+
+def test_cli_stdin_line_reader_handles_bursts():
+    """select()-safe stdin reader: a burst of lines written in one pipe
+    chunk must ALL surface immediately. The old readline()-after-select
+    pattern pulled the whole burst into Python's io buffer, returned one
+    line, and then select() saw an empty OS pipe — wedging the serve loop
+    on any bursty client until its next write."""
+    import os
+
+    from csat_tpu.serve.cli import _StdinLines
+
+    class F:
+        def __init__(self, fd):
+            self._fd = fd
+
+        def fileno(self):
+            return self._fd
+
+    r, w = os.pipe()
+    try:
+        os.write(w, b'{"id":1,"code":"x"}\n42\nhello\n')
+        reader = _StdinLines(F(r))
+        assert len(reader.read_lines(0.1)) == 3  # the whole burst, at once
+        assert not reader.eof
+        os.write(w, b"partial")  # no newline: held until complete
+        assert reader.read_lines(0.05) == []
+        os.write(w, b" done\n")
+        assert reader.read_lines(0.1) == ["partial done\n"]
+    finally:
+        os.close(w)
+    assert reader.read_lines(0.1) == [] and reader.eof
+    os.close(r)
+
+
+def test_tick_hang_trips_serve_watchdog(drilled):
+    """A hung tick (the wedged-dispatch mode) trips the tick-liveness
+    watchdog within its bounded window; the recorder action stands in for
+    the production resumable abort. Runs LAST of the watchdog drills —
+    the monitor is one-shot by design."""
+    cfg, model, params, eng, clock, tripped = drilled
+    _drill_reset(eng, cfg)
+    assert not tripped.is_set(), "watchdog tripped spuriously before the drill"
+    t0 = eng._tick_no
+    eng.fault_injector = FaultInjector(
+        serve_hang_at_tick=t0 + 1, hang_seconds=8.0)
+    reqs = eng.generate(_bucket0_requests(cfg, 2, seed=12), max_new_tokens=4)
+    eng.fault_injector = None
+    assert tripped.is_set(), "hung tick did not trip the serve watchdog"
+    # the hang cleared; the requests themselves still resolved OK
+    assert all(r.status == RequestStatus.OK for r in reqs)
