@@ -370,12 +370,23 @@ def _measure_serve(backend: str, dtype: str, num_slots: int,
 
     The trace draws skewed AST lengths (the corpora's small-skew) and
     skewed per-request token budgets; arrivals follow a seeded Poisson
-    process in decode-step units so the schedule is hardware-independent.
-    Both paths are credited the same useful tokens (each request's
-    generated tokens up to its EOS/budget); the engine stops rows at
-    retirement and refills slots, the baseline pays the full
-    ``max_tgt_len - 1`` fixed-step decode per batch — the gap between the
-    two ``gen_tokens_per_sec_per_chip`` numbers is the serving win.
+    process in decode-step units so the schedule is hardware-independent,
+    and ~1/4 of the submissions are exact repeats of earlier requests —
+    the near-duplicate-code workload the cross-request prefix cache
+    (``serve/prefix.py``) exists for.  Both paths are credited the same
+    useful tokens (each request's generated tokens up to its EOS/budget);
+    the engine stops rows at retirement and refills slots, the baseline
+    pays the full ``max_tgt_len - 1`` fixed-step decode per batch — the
+    gap between the two ``gen_tokens_per_sec_per_chip`` numbers is the
+    serving win.
+
+    KV memory protocol: the engine runs the block-paged layout with
+    ``2 * num_slots`` slots over EXACTLY the page budget a ``num_slots``
+    rectangle pool would occupy (``serve_num_pages`` pinned to the
+    worst-case chain total) — the record's ``effective_slots`` field is
+    the slots-per-rectangle-memory ratio (2.0 here by construction), and
+    skewed real budgets keep actual page demand under that budget, with
+    admission backpressure (not OOM) absorbing any burst past it.
     """
     import jax
     import numpy as np
@@ -383,6 +394,7 @@ def _measure_serve(backend: str, dtype: str, num_slots: int,
     from csat_tpu.configs import get_config
     from csat_tpu.data.toy import random_request_sample
     from csat_tpu.serve.engine import ServeEngine
+    from csat_tpu.serve.pages import page_geometry
     from csat_tpu.serve.prefill import collate_requests
     from csat_tpu.train.decode import greedy_decode
     from csat_tpu.train.state import create_train_state, default_optimizer, make_model
@@ -392,6 +404,12 @@ def _measure_serve(backend: str, dtype: str, num_slots: int,
                      serve_slots=num_slots)
     if backend == "pallas":
         overrides["noise_mode"] = "counter"
+    # equal-memory 2x-slots: pin the page pool to the rectangle budget of
+    # `num_slots` slots, then offer twice the slots over it
+    rect_geo = page_geometry(get_config("python", **overrides))
+    overrides["serve_kv_layout"] = "paged"
+    overrides["serve_slots"] = 2 * num_slots
+    overrides["serve_num_pages"] = 1 + num_slots * rect_geo.rect_pages_per_slot
     cfg = get_config("python", **overrides)
     src_v, tgt_v, trip_v = 10_000, 20_000, 1246
     steps = cfg.max_tgt_len - 1
@@ -405,6 +423,12 @@ def _measure_serve(backend: str, dtype: str, num_slots: int,
         random_request_sample(cfg, src_v, trip_v, int(lengths[i]), seed=100 + i)
         for i in range(n_requests)
     ]
+    # near-duplicate workload: every 4th request resubmits an earlier
+    # sample verbatim (identical content hash → prefix-cache hit; its own
+    # budget/arrival stay as drawn). The baseline decodes the same list,
+    # so the useful-token credit stays identical across both paths.
+    for i in range(3, n_requests, 4):
+        samples[i] = samples[int(rng.integers(0, i))]
 
     model = make_model(cfg, src_v, tgt_v, trip_v)
     tx = default_optimizer(cfg)
@@ -435,7 +459,7 @@ def _measure_serve(backend: str, dtype: str, num_slots: int,
     # up front, so an under-saturated engine trace would measure idle time,
     # not serving capacity)
     arrivals = np.cumsum(rng.exponential(
-        scale=float(budgets.mean()) / max(num_slots, 1) / 1.4,
+        scale=float(budgets.mean()) / max(cfg.serve_slots, 1) / 1.4,
         size=n_requests))  # decode-step units
     t0 = time.perf_counter()
     nxt = 0
@@ -480,6 +504,7 @@ def _measure_serve(backend: str, dtype: str, num_slots: int,
     n_chips = jax.device_count()
     tps = useful / engine_wall / n_chips
     base_tps = base_useful / base_wall / n_chips
+    summ = engine.stats.summary(wall_s=engine_wall, n_chips=n_chips)
     return {
         "ok": True,
         "backend": backend,
@@ -493,6 +518,14 @@ def _measure_serve(backend: str, dtype: str, num_slots: int,
         "steps": int(engine.stats.decode_steps),
         "step_ms": round(engine_wall / max(engine.stats.decode_steps, 1) * 1e3, 2),
         "num_slots": num_slots,
+        # block-paged pool at equal KV memory (see docstring): slots the
+        # engine actually ran, per rectangle-pool-slot's worth of memory
+        # (2.0 by construction), mean page occupancy of that budget, and
+        # the share of admissions the prefix cache served without prefill
+        "engine_slots": cfg.serve_slots,
+        "effective_slots": summ["effective_slots"],
+        "kv_page_occupancy": summ["kv_page_occupancy"],
+        "prefix_hit_rate": summ["prefix_hit_rate"],
         "requests": n_requests,
         "programs": compiles_warm,
         "gen_tokens": useful,
@@ -897,7 +930,9 @@ def main() -> None:
                                      "step_ms", "peak_hbm_gb", "xla_temp_gb",
                                      "nodes_per_sec_per_chip",
                                      "real_nodes_per_sec_per_chip",
-                                     "buckets", "num_slots", "requests",
+                                     "buckets", "num_slots", "engine_slots",
+                                     "effective_slots", "kv_page_occupancy",
+                                     "prefix_hit_rate", "requests",
                                      "gen_tokens_per_sec_per_chip",
                                      "batch_gen_tokens_per_sec_per_chip",
                                      "vs_batch_decode", "latency_p50_s",

@@ -157,6 +157,39 @@ class Config:
     # flagship-length prefills land in half-pool batches, short ones in
     # proportionally larger batches up to the pool size
     serve_prefill_budget: int = 0
+    # --- block-paged KV pool + prefix cache (csat_tpu/serve/pages.py) ---
+    # KV-cache layout for the serving slot pool:
+    #   "paged" — block-paged pool (serve/pages.py): fixed-size pages
+    #             allocated on demand from a free list at admission
+    #             (self-KV sized by the request's actual token budget,
+    #             cross-KV by its prefill bucket), reclaimed at retire;
+    #             the decode step gathers K/V through per-slot page-table
+    #             rows, so HBM scales with *offered* work, not the
+    #             worst-case (S,H,T,dh)+(S,H,N,dh) rectangles — the
+    #             order-of-magnitude-larger-slot-pool lever (PAPERS.md,
+    #             Ragged Paged Attention, arXiv 2604.15464).
+    #   "rect"  — the PR-3 per-slot rectangle pool (A/B reference; the two
+    #             layouts are bit-identical on deterministic configs,
+    #             pinned by tests/test_serve.py).
+    serve_kv_layout: str = "paged"
+    # tokens per KV page (one page = per-layer (H, page, dh) K and V
+    # storage addressed by a single id across every decoder layer)
+    serve_page_size: int = 16
+    # total pages in the pool, INCLUDING the reserved null page 0.
+    # 0 = auto: enough for every slot's worst-case chain
+    # (1 + serve_slots * (ceil(steps/page) + ceil(mem_len/page))) — same
+    # memory as the rectangle pool, zero admission stalls. Smaller values
+    # trade admission backpressure for memory: the bench's
+    # equal-memory-2x-slots configuration sets this explicitly.
+    serve_num_pages: int = 0
+    # cross-request prefix cache (serve/prefix.py): max entries mapping a
+    # content hash of the encoder input (the validated request sample) to
+    # a refcounted cross-KV page chain — an identical resubmission skips
+    # prefill entirely and SHARES the pages across concurrent requests
+    # (near-duplicate code submissions at scale). 0 = off. Entries evict
+    # LRU at capacity or on page-pool pressure, never while a live slot
+    # still references the chain. Only meaningful with the paged layout.
+    serve_prefix_cache: int = 64
     # --- serving resilience (csat_tpu/serve/engine.py) ---
     # admission control: bound on the engine's request queue (queued, not
     # in-flight). 0 = unbounded (the PR-3 behavior). When full, submit
@@ -345,6 +378,10 @@ class Config:
                     "'sample')"
                 )
         assert self.serve_slots >= 1, self.serve_slots
+        assert self.serve_kv_layout in ("paged", "rect"), self.serve_kv_layout
+        assert self.serve_page_size >= 1, self.serve_page_size
+        assert self.serve_num_pages >= 0, self.serve_num_pages
+        assert self.serve_prefix_cache >= 0, self.serve_prefix_cache
         assert self.serve_prefill_budget >= 0, self.serve_prefill_budget
         assert self.serve_max_queue >= 0, self.serve_max_queue
         assert self.serve_queue_policy in ("reject", "shed_oldest"), (

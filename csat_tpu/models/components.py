@@ -221,7 +221,19 @@ class MultiHeadAttention(nn.Module):
             # slot sits at its own position — the write becomes a per-row
             # one-hot select along the time axis (same stored values, same
             # O(B·H·T·dh) cost as the attention itself).
+            #
+            # A "paged" marker key (block-paged serving pool,
+            # csat_tpu/serve/pages.py) flips the cache OUTPUT contract: the
+            # input "k"/"v" are a transient rectangle GATHERED from the page
+            # pool (read-only — the persistent storage is the pages), so
+            # instead of echoing the merged rectangle back, the new cache
+            # carries only this step's per-token projections ("k_step" /
+            # "v_step", (B, H, 1, dh)) for the caller to scatter into each
+            # row's page chain. The attention math is the one-hot-merged
+            # rectangle either way — bit-identical to the rect layout.
             idx = cache["idx"]
+            paged = "paged" in cache
+            k_tok, v_tok = k, v
             if jnp.ndim(idx) == 0:
                 k = jax.lax.dynamic_update_slice_in_dim(cache["k"], k, idx, axis=2)
                 v = jax.lax.dynamic_update_slice_in_dim(cache["v"], v, idx, axis=2)
@@ -231,7 +243,10 @@ class MultiHeadAttention(nn.Module):
                 sel = hot[:, None, :, None]  # broadcast over heads / head_dim
                 k = jnp.where(sel, k, cache["k"])
                 v = jnp.where(sel, v, cache["v"])
-            cache = {"k": k, "v": v, "idx": idx + q_in.shape[1]}
+            if paged:
+                cache = {"k_step": k_tok, "v_step": v_tok}
+            else:
+                cache = {"k": k, "v": v, "idx": idx + q_in.shape[1]}
 
         scores = jnp.einsum("bhqd,bhkd->bhqk", q.astype(jnp.float32), k.astype(jnp.float32))
         scores = scores / math.sqrt(dh)

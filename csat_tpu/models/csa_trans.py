@@ -229,6 +229,26 @@ class CSATrans(nn.Module):
             for i, layer in enumerate(self.decoder.layers)
         }
 
+    def init_page_pool(self, num_pages: int, page_size: int) -> Dict[str, Any]:
+        """Zeroed per-layer K/V **page** arrays for the block-paged serving
+        pool (``csat_tpu/serve/pages.py``): ``(num_pages, H, page_size, dh)``
+        per layer for K and V.  One page *id* addresses the same slice of
+        every layer's K and V arrays, so a slot's chain is a single int32
+        row regardless of depth.  Page 0 is the engine's reserved null page
+        (never allocated); fresh arrays per leaf because the pool is
+        donated through the serving programs."""
+        cfg = self.cfg
+        dh = cfg.hidden_size // cfg.num_heads
+
+        def zeros():
+            return jnp.zeros(
+                (num_pages, cfg.num_heads, page_size, dh), dtype=self.dtype)
+
+        return {
+            f"layer_{i}": {"k": zeros(), "v": zeros()}
+            for i in range(len(self.decoder.layers))
+        }
+
     def init_slot_cache(self, num_slots: int, max_len: int, mem_len: int) -> Dict[str, Any]:
         """Zeroed per-layer K/V buffers for a pool of ``num_slots`` decode
         slots: self-attn ``(S, H, max_len, dh)`` and cross-attn

@@ -1,9 +1,15 @@
-"""Continuous-batching inference engine (slot-pooled KV cache, bucketed
-prefill, single compiled decode-step program) with a serving resilience
-layer: admission control + backpressure, per-request deadlines, poison
-quarantine at ingest, a NaN-logits guard, stuck-slot reaping, a
-tick-liveness watchdog, and bounded pool rebuild after device faults —
-every request ends in a structured :class:`RequestStatus`
+"""Continuous-batching inference engine over a block-paged KV pool
+(fixed-size pages allocated on demand from a free list, ragged
+paged-attention decode through per-slot page tables, a refcounted
+cross-request prefix cache that skips prefill on identical submissions —
+``serve/pages.py`` / ``serve/prefix.py``; the PR-3 per-slot rectangle
+layout remains as the ``serve_kv_layout="rect"`` A/B reference), with
+bucketed prefill, a single compiled decode-step program, and a serving
+resilience layer: admission control + backpressure (queue-bound AND
+page-pool), per-request deadlines, poison quarantine at ingest, a
+NaN-logits guard, stuck-slot reaping, a tick-liveness watchdog, and
+bounded pool rebuild after device faults — every request ends in a
+structured :class:`RequestStatus`
 (``OK | FAILED | TIMEOUT | REJECTED | SHED``).
 
 Entry points: :class:`ServeEngine` (submit/poll/tick/drain),
@@ -11,19 +17,35 @@ Entry points: :class:`ServeEngine` (submit/poll/tick/drain),
 ``bench.py``'s ``:serve`` mode.
 """
 
-from csat_tpu.serve.engine import Request, RequestStatus, ServeEngine  # noqa: F401
+from csat_tpu.serve.engine import (  # noqa: F401
+    PagePlan,
+    Request,
+    RequestStatus,
+    ServeEngine,
+)
 from csat_tpu.serve.ingest import (  # noqa: F401
     PoisonRequestError,
     sample_from_dataset,
     sample_from_source,
     validate_sample,
 )
+from csat_tpu.serve.pages import (  # noqa: F401
+    NULL_PAGE,
+    PageAllocator,
+    PagedPool,
+    PageGeometry,
+    build_paged_decode_step,
+    init_paged_pool,
+    page_geometry,
+)
 from csat_tpu.serve.prefill import (  # noqa: F401
     PrefillSpec,
     assign_prefill_bucket,
+    build_paged_prefill,
     build_prefill,
     collate_requests,
     prefill_plan,
 )
+from csat_tpu.serve.prefix import PrefixCache, sample_hash  # noqa: F401
 from csat_tpu.serve.slots import SlotPool, build_decode_step, init_pool  # noqa: F401
 from csat_tpu.serve.stats import ServeStats, percentile  # noqa: F401

@@ -49,6 +49,19 @@ def _parser() -> argparse.ArgumentParser:
                    help="orbax params dir (default: the config's output dir)")
     p.add_argument("--serve_slots", type=int, default=0,
                    help="decode-slot pool size (default: config serve_slots)")
+    p.add_argument("--kv_layout", default="",
+                   help="paged | rect KV-cache layout (default: config "
+                        "serve_kv_layout)")
+    p.add_argument("--page_size", type=int, default=0,
+                   help="tokens per KV page, paged layout (default: config "
+                        "serve_page_size)")
+    p.add_argument("--num_pages", type=int, default=-1,
+                   help="page-pool size incl. the null page; 0 = auto-size "
+                        "to every slot's worst case (default: config "
+                        "serve_num_pages)")
+    p.add_argument("--prefix_cache", type=int, default=-1,
+                   help="cross-request prefix-cache entries; 0 = off "
+                        "(default: config serve_prefix_cache)")
     p.add_argument("--max_new_tokens", type=int, default=0,
                    help="per-request decode budget (0 = max_tgt_len - 1)")
     p.add_argument("--max_queue", type=int, default=-1,
@@ -99,6 +112,14 @@ def build_engine(args):
         overrides["serve_queue_policy"] = args.queue_policy
     if getattr(args, "deadline_s", -1.0) >= 0:
         overrides["serve_deadline_s"] = args.deadline_s
+    if getattr(args, "kv_layout", ""):
+        overrides["serve_kv_layout"] = args.kv_layout
+    if getattr(args, "page_size", 0):
+        overrides["serve_page_size"] = args.page_size
+    if getattr(args, "num_pages", -1) >= 0:
+        overrides["serve_num_pages"] = args.num_pages
+    if getattr(args, "prefix_cache", -1) >= 0:
+        overrides["serve_prefix_cache"] = args.prefix_cache
     cfg = get_config(args.config, **overrides)
 
     src_vocab, tgt_vocab = load_vocab(cfg.data_dir)

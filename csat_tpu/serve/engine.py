@@ -1,10 +1,18 @@
 """ServeEngine: continuous-batching scheduler over the slot pool.
 
-The engine owns a fixed pool of ``cfg.serve_slots`` decode slots
-(``serve/slots.py``), a bounded FIFO request queue, and two kinds of
-compiled programs: ONE decode-step program advancing every live slot a
-token, and one bucketed prefill program per occupied encoder shape
-(``serve/prefill.py``).  Each :meth:`tick` is one scheduler round:
+The engine owns a fixed pool of ``cfg.serve_slots`` decode slots — by
+default the block-paged layout (``serve/pages.py``): KV lives in shared
+page arrays, each admission funds its chains from a host-side free list
+(self-KV sized by the request's actual token budget, cross-KV by its
+prefill bucket, or SHARED outright on a prefix-cache hit,
+``serve/prefix.py``), and retirement reclaims them, so slot count is no
+longer capped by worst-case rectangles (``serve_kv_layout="rect"`` keeps
+the PR-3 layout as the bit-identical A/B reference).  Around that sit a
+bounded FIFO request queue and up to three kinds of compiled programs:
+ONE decode-step program advancing every live slot a token, one bucketed
+prefill program per occupied encoder shape (``serve/prefill.py``), and
+(prefix cache on) ONE attach program admitting cache hits without running
+the encoder.  Each :meth:`tick` is one scheduler round:
 
 1. **retire** — rows that emitted EOS or exhausted their token budget hand
    their generated ids back to their request and free the slot; rows whose
@@ -13,10 +21,13 @@ token, and one bucketed prefill program per occupied encoder shape
    resolve TIMEOUT; admitted rows that stopped retiring (a wedged device
    row) are frozen and resolve FAILED after a bounded grace;
 3. **admit** — freed slots refill from the queue head: requests group by
-   smallest-fitting prefill bucket, each group runs the bucket's compiled
-   encoder at its own (smaller) node capacity and scatters memory/cache
-   into the free slot rows; a prefill that raises resolves its chunk
-   FAILED with the pool still serving;
+   smallest-fitting prefill bucket; each is funded with page chains first
+   (an unfundable request waits at the head — page backpressure, never a
+   mid-decode OOM), prefix-cache hits attach without encoding, and each
+   miss group runs the bucket's compiled encoder at its own (smaller)
+   node capacity, scattering cross-KV into the funded pages; a prefill
+   that raises resolves its chunk FAILED (pages refunded) with the pool
+   still serving;
 4. **decode** — the single decode-step program advances all live slots; a
    device fault escaping the dispatch triggers a bounded pool rebuild
    with in-flight work resubmitted (at-most-once delivery per attempt).
@@ -57,17 +68,29 @@ from csat_tpu.models import CSATrans
 from csat_tpu.resilience.retry import ErrorBudget
 from csat_tpu.resilience.watchdog import StepWatchdog
 from csat_tpu.serve.ingest import PoisonRequestError, validate_sample
+from csat_tpu.serve.pages import (
+    NULL_PAGE,
+    PageAllocator,
+    build_attach,
+    build_paged_decode_step,
+    build_release,
+    chain_table_row,
+    init_paged_pool,
+    page_geometry,
+)
 from csat_tpu.serve.prefill import (
     assign_prefill_bucket,
+    build_paged_prefill,
     build_prefill,
     collate_requests,
     prefill_plan,
 )
+from csat_tpu.serve.prefix import PrefixCache, sample_hash
 from csat_tpu.serve.slots import SlotPool, build_decode_step, init_pool
 from csat_tpu.serve.stats import ServeStats
-from csat_tpu.utils import EOS_WORD
+from csat_tpu.utils import EOS_WORD, PAD
 
-__all__ = ["Request", "RequestStatus", "ServeEngine"]
+__all__ = ["Request", "RequestStatus", "PagePlan", "ServeEngine"]
 
 
 class RequestStatus:
@@ -107,6 +130,9 @@ class Request:
     error: Optional[str] = None     # human-readable cause for non-OK outcomes
     attempts: int = 0               # resubmissions consumed by pool rebuilds
     admit_tick: Optional[int] = None  # engine tick at admission (reaper clock)
+    phash: Optional[bytes] = None   # content hash (prefix cache on): computed
+    #                                 ONCE at submit — admission may re-plan a
+    #                                 deferred request every tick
 
     @property
     def finished(self) -> bool:
@@ -115,6 +141,21 @@ class Request:
     @property
     def ok(self) -> bool:
         return self.status == RequestStatus.OK
+
+
+@dataclasses.dataclass
+class PagePlan:
+    """One admitted request's page funding (paged layout only): the self-KV
+    chain is always privately owned; the cross-KV chain is either private
+    (``shared=False`` — freed to the allocator at retire) or owned by the
+    prefix cache (``shared=True`` — retire releases the refcount and the
+    pages stay pinned for the next identical submission)."""
+
+    self_chain: List[int]
+    cross_chain: List[int]
+    phash: Optional[bytes]  # content hash (None when the cache is off)
+    hit: bool               # cross chain came from a prefix-cache hit
+    shared: bool            # cross chain is cache-owned, not allocator-owned
 
 
 class ServeEngine:
@@ -146,8 +187,26 @@ class ServeEngine:
         # deterministic fault drills (resilience/faults.py serve hooks)
         self.fault_injector = fault_injector
 
-        self._pool: SlotPool = init_pool(
-            model, {"params": params}, self.num_slots, self.steps, cfg.max_src_len)
+        # KV layout: block-paged pool (serve/pages.py) or the PR-3 per-slot
+        # rectangles — bit-identical outputs, radically different memory
+        self.paged = cfg.serve_kv_layout == "paged"
+        if self.paged:
+            self.geo = page_geometry(cfg)
+            self._allocator = PageAllocator(self.geo.num_pages)
+            self._prefix: Optional[PrefixCache] = (
+                PrefixCache(cfg.serve_prefix_cache)
+                if cfg.serve_prefix_cache > 0 else None)
+            self._pool = init_paged_pool(
+                model, {"params": params}, self.num_slots, self.geo)
+        else:
+            self.geo = None
+            self._allocator = None
+            self._prefix = None
+            self._pool = init_pool(
+                model, {"params": params}, self.num_slots, self.steps,
+                cfg.max_src_len)
+        # per-slot page funding, aligned with _slots (paged layout only)
+        self._slot_meta: List[Optional[PagePlan]] = [None] * self.num_slots
         self._slots: List[Optional[Request]] = [None] * self.num_slots
         self._queue: Deque[Request] = deque()
         self._results: Dict[int, Request] = {}
@@ -170,10 +229,26 @@ class ServeEngine:
         # a mostly-poison stream is upstream corruption, not noise
         self._poison_budget = ErrorBudget(cfg.serve_poison_budget, log=log)
 
+        # params are fixed for the engine's lifetime. The per-tick decode
+        # program CLOSES OVER the device copy (baked in as executable
+        # constants): flattening the ~hundred-leaf params pytree per
+        # dispatch is pure host overhead, and the serving loop is
+        # dispatch-bound between device steps (~34% cut on the 1-core
+        # box). The per-ADMISSION prefill programs take params as an
+        # explicit (non-donated) argument instead — a closed-over array
+        # is embedded per executable, so baking params into one program
+        # per occupied bucket would duplicate the whole parameter set
+        # several times over in device memory, eroding exactly the KV
+        # headroom the paged pool exists to create
+        self._dparams = jax.device_put(params)
+
         # the ONE decode-step program, AOT-compiled up front (pool donated:
         # slot state advances in place, no per-step copies)
-        step = jax.jit(build_decode_step(model), donate_argnums=(1,))
-        self._decode_prog = step.lower(self.params, self._pool).compile()
+        step_fn = (build_paged_decode_step(model, self.geo) if self.paged
+                   else build_decode_step(model))
+        step = jax.jit(lambda pool: step_fn(self._dparams, pool),
+                       donate_argnums=(0,))
+        self._decode_prog = step.lower(self._pool).compile()
         self.stats.record_compile("decode", (self.num_slots, self.steps))
         self._prefill_progs: Dict[int, Any] = {}
         # tiny host-side row surgery, shape-stable and jitted once each —
@@ -186,7 +261,37 @@ class ServeEngine:
             lambda pool, keep: pool._replace(
                 limit=jnp.where(keep, pool.limit, 0)),
             donate_argnums=(0,))
+        if self.paged:
+            # retire surgery: zero the budget AND null the page-table rows
+            # so a freed page handed to another request cannot be written
+            # by the old row's dead per-tick scatter.  AOT-compiled HERE:
+            # its first caller mid-traffic is a timeout/shed/reap/NaN
+            # retirement, and a lazy compile there would stall the tick
+            # loop while every in-flight deadline clock keeps running
+            fn = jax.jit(build_release(), donate_argnums=(0,))
+            self._release_prog = fn.lower(
+                self._pool, np.ones((self.num_slots,), bool)).compile()
+            self.stats.record_compile("release", (self.num_slots,))
+        else:
+            self._release_prog = self._freeze_prog
+        self._attach_prog = None
+        if self._prefix is not None:
+            # the prefix-cache hit path: one fixed (S,)-wide admission
+            # program, AOT-compiled HERE so a first hit mid-traffic cannot
+            # trip the steady-state zero-recompile tripwire
+            fn = jax.jit(build_attach(),
+                         donate_argnums=(0,))
+            self._attach_prog = fn.lower(
+                self._pool,
+                np.full((self.num_slots,), self.num_slots, np.int32),
+                np.zeros((self.num_slots,), np.int32),
+                np.zeros((self.num_slots, self.geo.sp), np.int32),
+                np.zeros((self.num_slots, self.geo.cp), np.int32),
+                np.ones((self.num_slots, self.geo.mem_len), bool),
+            ).compile()
+            self.stats.record_compile("attach", (self.num_slots,))
         self._nan_prog = None  # built lazily, fault drills only
+        self._sync_page_stats()
 
         # tick-liveness watchdog: the serving analogue of the step
         # watchdog — beats once per completed tick while work is in
@@ -247,6 +352,8 @@ class ServeEngine:
             self._finish(req, RequestStatus.FAILED,
                          error=f"poison request: {e}", now=now)
             return req.id
+        if self._prefix is not None:
+            req.phash = sample_hash(sample)
 
         # admission control: bounded queue with a structured outcome
         max_q = self.cfg.serve_max_queue
@@ -313,6 +420,8 @@ class ServeEngine:
         self._retire()
         self._expire_and_reap()
         self._admit()
+        if self.paged:
+            self.stats.note_pages(self._allocator.used_pages)
         live = sum(r is not None for r in self._slots)
         if live:
             try:
@@ -321,7 +430,7 @@ class ServeEngine:
                     if slot is not None:
                         self._inject_nan(slot)
                     inj.maybe_fail_decode(tick)
-                self._pool, status = self._decode_prog(self.params, self._pool)
+                self._pool, status = self._decode_prog(self._pool)
                 self._status = np.asarray(status)
                 self.stats.decode_steps += 1
             except Exception as e:  # noqa: BLE001 — device fault: self-heal
@@ -369,7 +478,7 @@ class ServeEngine:
             freeze.append(i)
             self._finish_slot(i, RequestStatus.SHED, error=reason, now=now)
             n += 1
-        self._freeze_rows(freeze)
+        self._release_rows(freeze)
         if self._watchdog is not None:
             self._watchdog.disarm()
         return n
@@ -397,7 +506,16 @@ class ServeEngine:
         self.stats = ServeStats(self.num_slots)
         self.stats.compile_events = list(old.compile_events)
         self.stats.started_t = self.clock()
+        self._sync_page_stats()
         return self.stats
+
+    def _sync_page_stats(self) -> None:
+        """Stamp the pool geometry onto the (possibly fresh) stats object so
+        ``summary()`` can report page occupancy and the equal-memory
+        effective-slots ratio."""
+        if self.paged:
+            self.stats.set_page_info(
+                self._allocator.usable, self.geo.rect_pages_per_slot)
 
     # ---------------- scheduler internals ----------------
 
@@ -438,7 +556,86 @@ class ServeEngine:
             req.n_tokens = pos
             req.tokens = np.array(toks[i, :pos])
         self._slots[i] = None
+        self._free_slot_meta(i)
         self._finish(req, status, error=error, now=now)
+
+    # ---------------- page accounting (paged layout) ----------------
+
+    def _free_slot_meta(self, i: int) -> None:
+        """Return slot ``i``'s page funding to the allocator / prefix cache
+        (host half of retirement; the device half is :meth:`_release_rows`).
+        Every terminal path — OK, NaN, timeout, reap, shed, prefill fault —
+        funnels through here, so no outcome can leak or double-free pages."""
+        plan = self._slot_meta[i]
+        if plan is None:
+            return
+        self._slot_meta[i] = None
+        self._free_plan(plan)
+
+    def _free_plan(self, plan: PagePlan) -> None:
+        self._allocator.free(plan.self_chain)
+        if plan.shared:
+            self._prefix.release(plan.phash)
+        else:
+            self._allocator.free(plan.cross_chain)
+
+    def _alloc_with_evict(self, n: int) -> Optional[List[int]]:
+        """Allocate ``n`` pages, evicting unreferenced prefix-cache entries
+        (LRU first) under pool pressure — cache pins never starve live
+        admissions, and entries with live sharers are never touched."""
+        chain = self._allocator.alloc(n)
+        if chain is not None or self._prefix is None:
+            return chain
+        for evicted in self._prefix.evict_for(n - self._allocator.free_pages):
+            self._allocator.free(evicted)
+        return self._allocator.alloc(n)
+
+    def _plan_pages(self, req: Request) -> Optional[PagePlan]:
+        """Fund one request's chains: self-KV sized by its ACTUAL token
+        budget, cross-KV by its prefill bucket — or a prefix-cache hit,
+        which shares an existing chain and needs no cross pages at all.
+        None (no state change) when the pool cannot fund it this tick; the
+        request waits at the queue head instead of wedging mid-decode."""
+        spec = self.specs[req.bucket]
+        sp_need = self.geo.self_pages(req.limit)
+        phash = None
+        if self._prefix is not None:
+            phash = req.phash if req.phash is not None else sample_hash(req.sample)
+            entry = self._prefix.acquire(phash)
+            if entry is not None:
+                self_chain = self._alloc_with_evict(sp_need)
+                if self_chain is None:
+                    self._prefix.release(phash)
+                    return None
+                self.stats.prefix_hits += 1
+                self._prefix.count_hit(phash)
+                return PagePlan(self_chain, list(entry.chain), phash,
+                                hit=True, shared=True)
+        self_chain = self._alloc_with_evict(sp_need)
+        if self_chain is None:
+            return None
+        cross_chain = self._alloc_with_evict(self.geo.cross_pages(spec.n))
+        if cross_chain is None:
+            self._allocator.free(self_chain)
+            return None
+        # hit/miss accounting happens HERE, on the funded plan — an
+        # unfundable request is re-planned every tick it waits, and those
+        # attempts must not deflate the headline prefix_hit_rate
+        if self._prefix is not None:
+            self.stats.prefix_misses += 1
+            self._prefix.count_miss()
+        return PagePlan(self_chain, cross_chain, phash, hit=False, shared=False)
+
+    def _release_rows(self, slots: Sequence[int]) -> None:
+        """Device half of slot retirement: zero the budget and (paged) null
+        the page-table rows so the rows' dead writes land on the null page
+        while their freed pages serve other requests.  One shape-stable
+        donated call, batched across the tick's retirements."""
+        if not len(slots):
+            return
+        keep = np.ones((self.num_slots,), bool)
+        keep[list(slots)] = False
+        self._pool = self._release_prog(self._pool, keep)
 
     def _freeze_rows(self, slots: Sequence[int]) -> None:
         """Zero the device-side budget of ``slots`` so the decode program
@@ -453,7 +650,30 @@ class ServeEngine:
     def _inject_nan(self, slot: int) -> None:
         """Fault drill: NaN-poison one slot's self-attention KV cache so
         the next decode step's logits for that row are non-finite — the
-        realistic on-device corruption the logits guard exists for."""
+        realistic on-device corruption the logits guard exists for.  Paged
+        layout: poison the pages of the slot's self chain (the same
+        storage), which also exercises the alloc-time scrub — those pages
+        return to the free list NaN-laden when the row retires FAILED."""
+        if self.paged:
+            if self._nan_prog is None:
+                def poison(pool, mask):
+                    m = mask[:, None, None, None]
+                    pages = {
+                        layer: {
+                            "k": jnp.where(m, jnp.nan, entry["k"]),
+                            "v": jnp.where(m, jnp.nan, entry["v"]),
+                        }
+                        for layer, entry in pool.pages.items()
+                    }
+                    return pool._replace(pages=pages)
+
+                self._nan_prog = jax.jit(poison)
+            mask = np.zeros((self.geo.num_pages,), bool)
+            meta = self._slot_meta[slot]
+            assert meta is not None, f"nan drill on an empty slot {slot}"
+            mask[list(meta.self_chain)] = True
+            self._pool = self._nan_prog(self._pool, mask)
+            return
         if self._nan_prog is None:
             def poison(pool: SlotPool, mask):
                 m = mask[:, None, None, None]
@@ -488,7 +708,7 @@ class ServeEngine:
         bad_rows = [i for i, req in enumerate(self._slots)
                     if req is not None and bad[i]]
         if bad_rows:
-            self._freeze_rows(bad_rows)
+            self._release_rows(bad_rows)
             for i in bad_rows:
                 self._finish_slot(
                     i, RequestStatus.FAILED,
@@ -504,7 +724,14 @@ class ServeEngine:
             req.n_tokens = int(pos[i])
             req.tokens = np.array(toks[i, : req.n_tokens])
             self._slots[i] = None
+            self._free_slot_meta(i)
             self._finish(req, RequestStatus.OK, now=now)
+        # no release dispatch for OK retires: a paged row that finishes
+        # nulls its OWN page-table rows inside the decode step (pages.py),
+        # so its dead writes are already on the null page before the freed
+        # pages can reach another request — and rectangle rows self-freeze
+        # via done / pos == limit. The release program stays for rows
+        # frozen OUTSIDE the step: NaN guard above, reap, shed, timeout.
 
     def _expire_and_reap(self) -> None:
         """Deadline expiry (queued + in-flight) and stuck-slot reaping."""
@@ -543,7 +770,18 @@ class ServeEngine:
                     i, RequestStatus.FAILED,
                     error=f"stuck slot reaped after "
                           f"{self._tick_no - req.admit_tick} ticks", now=now)
-        self._freeze_rows(freeze)
+        self._release_rows(freeze)
+
+    def _requeue_remainder(self, window: List[Request],
+                           remainder: List[Request]) -> None:
+        """Put an admission window's not-yet-admitted requests back at the
+        queue head in SUBMISSION order (``window`` order), not the
+        bucket-sorted order admission planned in — requeueing the sorted
+        list would permanently permute the queue, so shed_oldest could
+        shed a young request and deadline-less older work could starve."""
+        pending = {id(r) for r in remainder}
+        self._queue.extendleft(
+            reversed([r for r in window if id(r) in pending]))
 
     def _admit(self) -> None:
         free = [i for i, r in enumerate(self._slots) if r is None]
@@ -557,20 +795,44 @@ class ServeEngine:
             req.bucket = k
             groups[k].append(req)
         # deterministic admission order: buckets ascending, FIFO within a
-        # bucket, slots assigned in ascending index order
+        # bucket, slots assigned in ascending index order. Page funding
+        # (paged layout) happens per request IN this order, so the
+        # request → (bucket, slot) map is a pure function of the trace
+        # regardless of layout or prefix-cache state.
         order = [req for k in sorted(groups) for req in groups[k]]
         while order:
             k = order[0].bucket
             chunk: List[Request] = []
+            plans: List[PagePlan] = []
             while (order and order[0].bucket == k
                     and len(chunk) < self.specs[k].batch_size):
+                if self.paged:
+                    plan = self._plan_pages(order[0])
+                    if plan is None:
+                        break  # pool cannot fund this request this tick
+                    plans.append(plan)
                 chunk.append(order.pop(0))
+            if not chunk:
+                # page backpressure: requeue the unfunded remainder at the
+                # head (retires this tick free pages; admission retries
+                # next tick) — a structured wait, never a mid-decode OOM.
+                # Requeued in SUBMISSION order, not the bucket-sorted
+                # admission order: the queue's FIFO contract is what
+                # shed_oldest and deadline fairness are defined against
+                self._requeue_remainder(window, order)
+                return
             slot_ids = [free.pop(0) for _ in chunk]
             try:
-                self._prefill_chunk(k, chunk, slot_ids)
+                self._prefill_chunk(k, chunk, slot_ids, plans)
             except Exception as e:  # noqa: BLE001 — admission program fault
                 now = self.clock()
-                for req in chunk:
+                for j, req in enumerate(chunk):
+                    # no chunk member is in _slots yet: _mark_admitted — the
+                    # only writer of req.slot/_slots — is the final,
+                    # non-raising statement of both prefill paths, so every
+                    # funded plan is still privately owned here
+                    if plans:
+                        self._free_plan(plans[j])
                     self._finish(
                         req, RequestStatus.FAILED,
                         error=f"prefill failed: {type(e).__name__}: {e}",
@@ -583,18 +845,22 @@ class ServeEngine:
                     # survivors in front, preserving global FIFO) and
                     # rebuild — freezing rows on a deleted pool would be
                     # the secondary crash that escapes tick()
-                    self._queue.extendleft(reversed(order))
+                    self._requeue_remainder(window, order)
                     self._rebuild_and_resubmit(e)
                     return
                 # fault before dispatch consumed the buffers (collate,
                 # validation, injected pre-dispatch failure): the pool is
                 # intact — the chunk resolves FAILED, its slots return to
                 # the free list, and the pool keeps serving
-                self._freeze_rows(slot_ids)
+                self._release_rows(slot_ids)
                 free = slot_ids + free
                 free.sort()
 
-    def _prefill_chunk(self, k: int, chunk: List[Request], slot_ids: List[int]) -> None:
+    def _prefill_chunk(self, k: int, chunk: List[Request], slot_ids: List[int],
+                       plans: List[PagePlan]) -> None:
+        if self.paged:
+            self._prefill_chunk_paged(k, chunk, slot_ids, plans)
+            return
         spec = self.specs[k]
         batch = collate_requests([r.sample for r in chunk], spec.n, spec.batch_size, self.cfg)
         # pad the id/limit vectors to the bucket batch with an out-of-range
@@ -603,26 +869,133 @@ class ServeEngine:
         ids[: len(slot_ids)] = slot_ids
         limits = np.zeros((spec.batch_size,), np.int32)
         limits[: len(chunk)] = [r.limit for r in chunk]
-        key = jax.random.fold_in(self._base_key, self._n_prefills)
+        ordinal = np.int32(self._n_prefills)
         call_ordinal = self._n_prefills
         self._n_prefills += 1
         if self.fault_injector is not None:
             self.fault_injector.maybe_fail_prefill(call_ordinal)
         prog = self._prefill_progs.get(k)
         if prog is None:
-            fn = jax.jit(build_prefill(self.model, spec), donate_argnums=(5,))
-            prog = fn.lower(self.params, batch, ids, limits, key, self._pool).compile()
+            pf = build_prefill(self.model, spec)
+            # params explicit (see __init__); the per-call sample key is
+            # derived INSIDE the program from the prefill ordinal — same
+            # fold_in math, one fewer host dispatch per admission
+            fn = jax.jit(
+                lambda params, batch, ids, limits, ordinal, pool: pf(
+                    params, batch, ids, limits,
+                    jax.random.fold_in(self._base_key, ordinal), pool),
+                donate_argnums=(5,))
+            prog = fn.lower(self._dparams, batch, ids, limits, ordinal,
+                            self._pool).compile()
             self._prefill_progs[k] = prog
             self.stats.record_compile("prefill", (spec.n, spec.batch_size))
-        self._pool = prog(self.params, batch, ids, limits, key, self._pool)
+        self._pool = prog(self._dparams, batch, ids, limits, ordinal,
+                          self._pool)
         self.stats.prefill_calls += 1
+        self._mark_admitted(chunk, slot_ids, plans)
+
+    def _prefill_chunk_paged(self, k: int, chunk: List[Request],
+                             slot_ids: List[int], plans: List[PagePlan]) -> None:
+        """Paged admission for one bucket chunk: prefix-cache misses run
+        the bucket's encoder program writing cross-KV into their chains;
+        hits skip the encoder entirely and go through the (S,)-wide attach
+        program.  Chunk-level failure semantics match the rectangle path:
+        a fault fails the whole chunk (handled by :meth:`_admit`)."""
+        spec = self.specs[k]
+        geo = self.geo
+        misses = [(req, s, p) for req, s, p in zip(chunk, slot_ids, plans)
+                  if not p.hit]
+        hits = [(req, s, p) for req, s, p in zip(chunk, slot_ids, plans)
+                if p.hit]
+        if misses:
+            b = spec.batch_size
+            cpn = geo.cross_pages(spec.n)
+            batch = collate_requests(
+                [req.sample for req, _, _ in misses], spec.n, b, self.cfg)
+            ids = np.full((b,), self.num_slots, np.int32)
+            ids[: len(misses)] = [s for _, s, _ in misses]
+            limits = np.zeros((b,), np.int32)
+            limits[: len(misses)] = [req.limit for req, _, _ in misses]
+            self_rows = np.full((b, geo.sp), NULL_PAGE, np.int32)
+            # sentinel (out-of-range) cross page ids on padding rows: the
+            # prefill's mode="drop" scatters discard them, so a ragged
+            # group never writes a page it does not own
+            cross_chain = np.full((b, cpn), geo.num_pages, np.int32)
+            for j, (req, _, plan) in enumerate(misses):
+                self_rows[j] = chain_table_row(plan.self_chain, geo.sp)
+                cross_chain[j] = plan.cross_chain
+            ordinal = np.int32(self._n_prefills)
+            call_ordinal = self._n_prefills
+            self._n_prefills += 1
+            if self.fault_injector is not None:
+                self.fault_injector.maybe_fail_prefill(call_ordinal)
+            prog = self._prefill_progs.get(k)
+            if prog is None:
+                pf = build_paged_prefill(self.model, spec, geo)
+                # params explicit + in-program sample key, as in the rect
+                # path
+                fn = jax.jit(
+                    lambda params, batch, ids, limits, self_rows,
+                           cross_chain, ordinal, pool: pf(
+                        params, batch, ids, limits, self_rows,
+                        cross_chain,
+                        jax.random.fold_in(self._base_key, ordinal), pool),
+                    donate_argnums=(7,))
+                prog = fn.lower(self._dparams, batch, ids, limits, self_rows,
+                                cross_chain, ordinal, self._pool).compile()
+                self._prefill_progs[k] = prog
+                self.stats.record_compile("prefill", (spec.n, spec.batch_size))
+            self._pool = prog(self._dparams, batch, ids, limits, self_rows,
+                              cross_chain, ordinal, self._pool)
+            self.stats.prefill_calls += 1
+            if self._prefix is not None:
+                # publish the fresh chains — ownership moves to the cache
+                # (refs=1: the inserting request), so the pages stay warm
+                # for the next identical submission. A declined insert
+                # (duplicate in-chunk hash, or capacity pinned by live
+                # sharers) leaves the chain privately owned — freed at
+                # retire like any other.
+                for req, _, plan in misses:
+                    evicted = self._prefix.insert(plan.phash, plan.cross_chain)
+                    if evicted is not None:
+                        plan.shared = True
+                        for chain in evicted:
+                            self._allocator.free(chain)
+        if hits:
+            s_att = self.num_slots
+            ids = np.full((s_att,), self.num_slots, np.int32)
+            limits = np.zeros((s_att,), np.int32)
+            self_rows = np.full((s_att, geo.sp), NULL_PAGE, np.int32)
+            cross_rows = np.full((s_att, geo.cp), NULL_PAGE, np.int32)
+            smask = np.ones((s_att, geo.mem_len), bool)
+            for j, (req, slot, plan) in enumerate(hits):
+                ids[j] = slot
+                limits[j] = req.limit
+                self_rows[j] = chain_table_row(plan.self_chain, geo.sp)
+                cross_rows[j] = chain_table_row(plan.cross_chain, geo.cp)
+                # identical content hash ⇒ identical src_seq ⇒ identical
+                # pad mask — derived from THIS request's own sample, with
+                # keys beyond the bucket width forced True exactly as the
+                # miss path's bucket truncation masks them (validate_sample
+                # does not forbid non-PAD garbage past num_node, and the
+                # shared chain holds zeros there)
+                sm = np.asarray(req.sample["src_seq"]) == PAD
+                sm[spec.n:] = True
+                smask[j] = sm
+            self._pool = self._attach_prog(
+                self._pool, ids, limits, self_rows, cross_rows, smask)
+        self._mark_admitted(chunk, slot_ids, plans)
+
+    def _mark_admitted(self, chunk: List[Request], slot_ids: List[int],
+                       plans: List[PagePlan]) -> None:
         self.stats.admitted += len(chunk)
         now = self.clock()
-        for req, s in zip(chunk, slot_ids):
+        for j, (req, s) in enumerate(zip(chunk, slot_ids)):
             req.admit_t = now
             req.slot = s
             req.admit_tick = self._tick_no
             self._slots[s] = req
+            self._slot_meta[s] = plans[j] if plans else None
 
     def _rebuild_and_resubmit(self, exc: BaseException) -> None:
         """Self-healing after a device fault escaped the decode dispatch:
@@ -646,10 +1019,22 @@ class ServeEngine:
                  f"rebuild #{self._rebuilds}, resubmitting "
                  f"{len(inflight)} in-flight request(s)")
         self._slots = [None] * self.num_slots
+        self._slot_meta = [None] * self.num_slots
         self._status = None
-        self._pool = init_pool(
-            self.model, {"params": self.params}, self.num_slots, self.steps,
-            self.cfg.max_src_len)
+        if self.paged:
+            # the device arrays are undefined: reset the free list and drop
+            # every prefix refcount WITH them — in-flight sharers are being
+            # requeued below and will re-fund (and re-prefill) from scratch,
+            # so nothing stays pinned (pinned by tests/test_pages.py)
+            self._allocator = PageAllocator(self.geo.num_pages)
+            if self._prefix is not None:
+                self._prefix.clear()
+            self._pool = init_paged_pool(
+                self.model, {"params": self.params}, self.num_slots, self.geo)
+        else:
+            self._pool = init_pool(
+                self.model, {"params": self.params}, self.num_slots,
+                self.steps, self.cfg.max_src_len)
         now = self.clock()
         survivors = []
         for req in sorted(inflight, key=lambda r: r.id):

@@ -1,0 +1,372 @@
+"""Block-paged KV pool: page allocator, paged slot state, ragged paged decode.
+
+The rectangle pool (``serve/slots.py``) pre-allocates worst-case
+``(S, H, T, dh)`` self-KV and ``(S, H, N, dh)`` cross-KV regions per slot,
+so HBM scales with the padded budget even for short requests and the slot
+count is capped by the rectangle.  This module pages that storage instead
+(PAPERS.md: Ragged Paged Attention, arXiv 2604.15464): per layer, K and V
+live in fixed-size **page** arrays ``(num_pages, H, page, dh)``, and each
+slot owns two fixed-width int32 page-table rows — ``self_pt`` (ceil(T/page)
+entries) and ``cross_pt`` (ceil(N/page) entries).  One page id addresses
+the same slice of every layer's K and V arrays, so a chain is a single id
+list regardless of decoder depth.
+
+* **Allocation** is host-side (:class:`PageAllocator`, a free list over
+  pages ``1..num_pages-1``): the engine funds a request's chains at
+  admission — self-KV sized by its *actual* token budget, cross-KV by its
+  prefill bucket — and reclaims them at retire/timeout/shed/reap.  Page 0
+  is the reserved **null page**: unallocated table entries point at it, and
+  frozen rows' dead writes are routed to it, so table surgery never
+  corrupts live pages.
+* **Decode** stays ONE shape-stable donated program
+  (:func:`build_paged_decode_step`): it gathers each row's K/V rectangle
+  through its page-table row, one-hot-merges the current token (the
+  ``paged`` cache mode in ``models/components.py:MultiHeadAttention``),
+  and scatters only the new per-token K/V back into the page owning
+  position ``pos`` — rows mid-way through different requests, with
+  different chain lengths, coexist in one executable with zero recompiles.
+* **Sharing**: cross-KV pages are read-only at decode, so identical
+  encoder inputs can share one chain across concurrent slots — the
+  refcounted prefix cache (``serve/prefix.py``) rides on exactly that.
+
+Exactness: the gathered rectangle is sliced to the rect pool's exact
+``(S, H, T, dh)`` / ``(S, H, N, dh)`` widths, position ``j`` of a chain
+maps to page ``j // page`` offset ``j % page``, and the merge/mask math is
+the rect path's math — so the paged engine is bit-identical to the
+rectangle pool (and to fresh ``greedy_decode``) on deterministic configs,
+pinned by ``tests/test_serve.py``.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, NamedTuple, Optional, Sequence
+
+import jax.numpy as jnp
+import numpy as np
+
+from csat_tpu.configs import Config
+from csat_tpu.models import CSATrans
+from csat_tpu.serve.slots import admit_slot_state
+from csat_tpu.utils import EOS, PAD
+
+__all__ = [
+    "NULL_PAGE",
+    "PageGeometry",
+    "PageAllocator",
+    "PagedPool",
+    "page_geometry",
+    "chain_table_row",
+    "init_paged_pool",
+    "build_paged_decode_step",
+    "build_attach",
+    "build_release",
+]
+
+NULL_PAGE = 0  # reserved: never allocated, target of unallocated table entries
+
+
+class PageGeometry(NamedTuple):
+    """Static shape facts of one paged pool (all derived from the config)."""
+
+    page: int       # tokens per page
+    num_pages: int  # total pages INCLUDING the null page
+    sp: int         # self page-table width  = ceil(steps / page)
+    cp: int         # cross page-table width = ceil(mem_len / page)
+    steps: int      # decode budget capacity (max_tgt_len - 1)
+    mem_len: int    # encoder memory width (max_src_len)
+
+    @property
+    def usable(self) -> int:
+        """Allocatable pages (the null page is reserved)."""
+        return self.num_pages - 1
+
+    @property
+    def rect_pages_per_slot(self) -> int:
+        """Pages one rectangle slot's worst-case KV regions occupy — the
+        equal-memory yardstick for the 2x-slots bench claim."""
+        return self.sp + self.cp
+
+    def self_pages(self, limit: int) -> int:
+        """Chain length funding a ``limit``-token decode budget."""
+        return max(1, -(-int(limit) // self.page))
+
+    def cross_pages(self, n: int) -> int:
+        """Chain length funding an ``n``-node encoder memory."""
+        return max(1, -(-int(n) // self.page))
+
+
+def page_geometry(cfg: Config) -> PageGeometry:
+    """Pool geometry for a config; ``serve_num_pages == 0`` auto-sizes to
+    every slot's worst-case chain (rectangle-pool memory, zero admission
+    stalls) — smaller explicit values trade backpressure for memory.
+
+    An explicit pool must fund at least one worst-case request
+    (``num_pages >= 1 + sp + cp``): below that, a max-length request can
+    NEVER be funded, and because backpressure waits at the queue head it
+    would wedge admission forever with no structured outcome — so the
+    misconfiguration fails loud here, at engine construction, instead."""
+    page = cfg.serve_page_size
+    steps = cfg.max_tgt_len - 1
+    mem_len = cfg.max_src_len
+    sp = -(-steps // page)
+    cp = -(-mem_len // page)
+    num_pages = cfg.serve_num_pages or (1 + cfg.serve_slots * (sp + cp))
+    if num_pages < 1 + sp + cp:
+        raise ValueError(
+            f"serve_num_pages={num_pages} cannot fund one worst-case request: "
+            f"need >= 1 null + {sp} self + {cp} cross pages "
+            f"(page_size={page}, steps={steps}, mem_len={mem_len})")
+    return PageGeometry(page, num_pages, sp, cp, steps, mem_len)
+
+
+class PageAllocator:
+    """Host-side free-list allocator over page ids ``1..num_pages-1``.
+
+    All-or-nothing :meth:`alloc` (an admission either funds a request's
+    whole chain or defers it — no mid-decode out-of-pages path exists by
+    construction), explicit :meth:`free`, and hard invariants: a page is
+    never handed out twice (aliasing) and never freed twice, enforced with
+    assertions because either bug silently corrupts another request's KV.
+    """
+
+    def __init__(self, num_pages: int):
+        assert num_pages >= 2, f"need >= 2 pages (one is the null page), got {num_pages}"
+        self.num_pages = num_pages
+        self._free: List[int] = list(range(num_pages - 1, 0, -1))  # pop() yields 1, 2, …
+        self._used: set = set()
+
+    @property
+    def free_pages(self) -> int:
+        return len(self._free)
+
+    @property
+    def used_pages(self) -> int:
+        return len(self._used)
+
+    @property
+    def usable(self) -> int:
+        return self.num_pages - 1
+
+    def alloc(self, n: int) -> Optional[List[int]]:
+        """``n`` pages, or None (and no state change) when the pool cannot
+        fund them — callers evict/defer, they never get a partial chain."""
+        assert n >= 0, n
+        if n > len(self._free):
+            return None
+        chain = [self._free.pop() for _ in range(n)]
+        self._used.update(chain)
+        return chain
+
+    def free(self, chain: Sequence[int]) -> None:
+        for p in chain:
+            p = int(p)
+            assert p != NULL_PAGE, "freeing the null page"
+            assert p in self._used, f"double-free / foreign page {p}"
+            self._used.remove(p)
+            self._free.append(p)
+
+
+class PagedPool(NamedTuple):
+    """Device-resident paged slot state; a pytree donated through every
+    serving program.  Identical to :class:`~csat_tpu.serve.slots.SlotPool`
+    except the per-slot KV rectangles are replaced by shared page arrays
+    plus fixed-shape per-slot page-table rows — which is what keeps the
+    decode program shape-stable and donation-safe while per-request memory
+    goes ragged."""
+
+    pages: Dict[str, Any]     # per-layer {"k","v"}: (num_pages, H, page, dh)
+    self_pt: jnp.ndarray      # (S, SP) int32 — self-KV chain (NULL_PAGE beyond)
+    cross_pt: jnp.ndarray     # (S, CP) int32 — cross-KV chain (NULL_PAGE beyond)
+    src_mask: jnp.ndarray     # (S, N) bool — True = pad key (all-True when free)
+    tok: jnp.ndarray          # (S, 1) int32 — current decoder input token
+    pos: jnp.ndarray          # (S,) int32 — tokens generated so far
+    limit: jnp.ndarray        # (S,) int32 — per-request budget; 0 ⇒ slot frozen
+    done: jnp.ndarray         # (S,) bool — row emitted EOS
+    prev_pad: jnp.ndarray     # (S, T) bool — pad-ness of decoder inputs so far
+    toks: jnp.ndarray         # (S, T) int32 — generated ids (PAD beyond pos)
+
+
+def chain_table_row(chain: Sequence[int], width: int) -> np.ndarray:
+    """A chain as a fixed-width table row, NULL_PAGE beyond its length
+    (unallocated entries gather the null page; their lanes are masked)."""
+    row = np.full((width,), NULL_PAGE, np.int32)
+    row[: len(chain)] = chain
+    return row
+
+
+def init_paged_pool(model: CSATrans, variables: Any, num_slots: int,
+                    geo: PageGeometry) -> PagedPool:
+    """A pool of ``num_slots`` empty slots over ``geo.num_pages`` pages.
+    Every slot starts frozen (``limit = 0``) with null page tables;
+    admission (prefill/attach) brings slots live."""
+    pages = model.apply(
+        variables, geo.num_pages, geo.page, method=CSATrans.init_page_pool)
+    return PagedPool(
+        pages=pages,
+        self_pt=jnp.full((num_slots, geo.sp), NULL_PAGE, jnp.int32),
+        cross_pt=jnp.full((num_slots, geo.cp), NULL_PAGE, jnp.int32),
+        src_mask=jnp.ones((num_slots, geo.mem_len), dtype=bool),
+        tok=jnp.full((num_slots, 1), PAD, dtype=jnp.int32),
+        pos=jnp.zeros((num_slots,), dtype=jnp.int32),
+        limit=jnp.zeros((num_slots,), dtype=jnp.int32),
+        done=jnp.zeros((num_slots,), dtype=bool),
+        prev_pad=jnp.zeros((num_slots, geo.steps), dtype=bool),
+        toks=jnp.full((num_slots, geo.steps), PAD, dtype=jnp.int32),
+    )
+
+
+def gather_chain(pages: jnp.ndarray, table: jnp.ndarray, width: int) -> jnp.ndarray:
+    """Assemble per-slot K or V rectangles through the page table.
+
+    ``pages`` (NP, H, page, dh), ``table`` (S, W) → ``(S, H, width, dh)``
+    where position ``j`` of row ``s`` is page ``table[s, j // page]``
+    offset ``j % page`` — the rect pool's exact layout, sliced to its
+    exact width so downstream masking/softmax is bit-identical."""
+    np_, h, page, dh = pages.shape
+    s, w = table.shape
+    g = pages[table]                                  # (S, W, H, page, dh)
+    g = g.transpose(0, 2, 1, 3, 4).reshape(s, h, w * page, dh)
+    return g[:, :, :width, :]
+
+
+def build_paged_decode_step(model: CSATrans, geo: PageGeometry):
+    """→ ``step(params, pool) -> (pool, status)``: advance every live slot
+    one token, reading K/V through each row's page chain.  Pure and
+    shape-stable — the engine AOT-compiles it exactly once (donating the
+    pool) and dispatches the same executable forever, for ANY mix of chain
+    lengths; ``status`` is the same packed ``(S, 3)`` ``[pos, done, bad]``
+    snapshot the rectangle path emits (``serve/slots.py``), so the host
+    scheduler is layout-oblivious.
+
+    The per-token K/V write targets page ``self_pt[s, pos // page]`` at
+    offset ``pos % page``; frozen rows (and rows whose tables were nulled
+    at retire) are routed to the null page, so a freed page can be handed
+    to another request the same tick without corruption."""
+    page = geo.page
+
+    def step(params, pool: PagedPool):
+        s = pool.pos.shape[0]
+        cache = {}
+        for layer, entry in pool.pages.items():
+            cache[layer] = {
+                "self": {
+                    "k": gather_chain(entry["k"], pool.self_pt, geo.steps),
+                    "v": gather_chain(entry["v"], pool.self_pt, geo.steps),
+                    "idx": pool.pos,
+                    "paged": True,  # components.py: emit k_step/v_step only
+                },
+                "cross": {
+                    "k": gather_chain(entry["k"], pool.cross_pt, geo.mem_len),
+                    "v": gather_chain(entry["v"], pool.cross_pt, geo.mem_len),
+                },
+            }
+        log_probs, new_cache = model.apply(
+            {"params": params}, pool.tok, pool.pos, cache, None,
+            pool.src_mask, pool.prev_pad, method=CSATrans.decode_step,
+        )
+        nxt = jnp.argmax(log_probs, axis=-1).astype(jnp.int32)  # (S,)
+        act = (~pool.done) & (pool.pos < pool.limit)
+        bad = act & jnp.any(~jnp.isfinite(log_probs), axis=-1)
+        nxt = jnp.where(act, nxt, PAD)
+
+        # persist this step's K/V into the page owning position pos.
+        # Active rows always land inside their own chain (admission funded
+        # ceil(limit/page) pages and pos < limit); everyone else goes to
+        # the null page — a dead write by design.
+        pidx = jnp.clip(pool.pos // page, 0, geo.sp - 1)
+        page_ids = jnp.take_along_axis(pool.self_pt, pidx[:, None], axis=1)[:, 0]
+        page_ids = jnp.where(act, page_ids, NULL_PAGE)
+        offs = pool.pos % page
+        pages = {}
+        for layer, entry in pool.pages.items():
+            knew = new_cache[layer]["self"]["k_step"][:, :, 0, :]  # (S, H, dh)
+            vnew = new_cache[layer]["self"]["v_step"][:, :, 0, :]
+            pages[layer] = {
+                "k": entry["k"].at[page_ids, :, offs, :].set(knew),
+                "v": entry["v"].at[page_ids, :, offs, :].set(vnew),
+            }
+
+        t_cap = pool.toks.shape[1]
+        ar = jnp.arange(t_cap)[None, :]
+        write = (ar == pool.pos[:, None]) & act[:, None]
+        toks = jnp.where(write, nxt[:, None], pool.toks)
+        write_next = (ar == (pool.pos + 1)[:, None]) & act[:, None]
+        prev_pad = jnp.where(write_next, (nxt == PAD)[:, None], pool.prev_pad)
+
+        done = pool.done | (act & (nxt == EOS))
+        pos = jnp.where(act, pool.pos + 1, pool.pos)
+        tok = jnp.where(act[:, None], nxt[:, None], pool.tok)
+        # a row that just finished (EOS or exhausted budget) nulls its OWN
+        # page-table rows: by the time the host observes the retire and
+        # hands the freed pages to another request, the row's per-tick dead
+        # write is already routed to the null page — the common OK-retire
+        # path needs no separate release dispatch (the host-side release
+        # program remains for rows frozen outside the step: NaN guard,
+        # reap, shed, timeout).  Observable outputs are untouched: an
+        # inactive row's gather reads the null page but its logits are
+        # discarded (nxt gated to PAD, bad gated by act).
+        alive = (~done) & (pos < pool.limit)
+        new_pool = PagedPool(
+            pages=pages,
+            self_pt=jnp.where(alive[:, None], pool.self_pt, NULL_PAGE),
+            cross_pt=jnp.where(alive[:, None], pool.cross_pt, NULL_PAGE),
+            src_mask=pool.src_mask, tok=tok, pos=pos, limit=pool.limit,
+            done=done, prev_pad=prev_pad, toks=toks,
+        )
+        status = jnp.stack(
+            [pos, done.astype(jnp.int32), bad.astype(jnp.int32)], axis=1)
+        return new_pool, status
+
+    return step
+
+
+def build_attach():
+    """→ ``attach(pool, slot_ids, limits, self_rows, cross_rows, smask)``:
+    bring slots live WITHOUT running the encoder — the
+    prefix-cache hit path, where the cross-KV pages already hold an
+    identical earlier request's projections and only the per-slot decode
+    state (tables, mask, BOS, budget) needs writing.
+
+    ``slot_ids`` (S,) int32 with out-of-range sentinel rows dropped by the
+    scatters (``mode="drop"``) — one compiled program (its width fixed by
+    the engine at lowering time) serves any number of hits.  Freshly
+    allocated self pages are scrubbed to zero here (a freed
+    page may carry a NaN-poisoned predecessor's values, and a 0-weight NaN
+    lane would still poison the softmax output); scrub writes from
+    sentinel/padding table entries land on the null page, harmlessly."""
+
+    def attach(pool: PagedPool, slot_ids, limits, self_rows, cross_rows, smask):
+        b = slot_ids.shape[0]
+        scrub = self_rows.reshape(-1)  # NULL_PAGE entries re-zero the null page
+        pages = {
+            layer: {
+                "k": entry["k"].at[scrub].set(0.0),
+                "v": entry["v"].at[scrub].set(0.0),
+            }
+            for layer, entry in pool.pages.items()
+        }
+        return PagedPool(
+            pages=pages,
+            self_pt=pool.self_pt.at[slot_ids].set(self_rows, mode="drop"),
+            cross_pt=pool.cross_pt.at[slot_ids].set(cross_rows, mode="drop"),
+            **admit_slot_state(pool, slot_ids, limits, smask, b),
+        )
+
+    return attach
+
+
+def build_release():
+    """→ ``release(pool, keep) -> pool``: retire slots device-side — zero
+    the budget (the decode program's ``act`` gate) AND null the page-table
+    rows, so the rows' per-tick dead writes land on the null page instead
+    of pages the free list may hand to another request.  Donated: every
+    untouched leaf (the whole page pool) aliases its input buffer."""
+
+    def release(pool: PagedPool, keep):
+        return pool._replace(
+            limit=jnp.where(keep, pool.limit, 0),
+            self_pt=jnp.where(keep[:, None], pool.self_pt, NULL_PAGE),
+            cross_pt=jnp.where(keep[:, None], pool.cross_pt, NULL_PAGE),
+        )
+
+    return release

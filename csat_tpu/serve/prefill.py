@@ -29,8 +29,8 @@ from csat_tpu.configs import Config
 from csat_tpu.data.bucketing import src_bucket_ladder
 from csat_tpu.data.dataset import Batch, collate
 from csat_tpu.models import CSATrans
-from csat_tpu.serve.slots import SlotPool
-from csat_tpu.utils import BOS, PAD
+from csat_tpu.serve.slots import SlotPool, admit_slot_state
+from csat_tpu.utils import PAD
 
 __all__ = [
     "PrefillSpec",
@@ -38,6 +38,7 @@ __all__ = [
     "assign_prefill_bucket",
     "collate_requests",
     "build_prefill",
+    "build_paged_prefill",
 ]
 
 
@@ -131,7 +132,6 @@ def build_prefill(model: CSATrans, spec: PrefillSpec):
         )
         cross = model.apply({"params": params}, memory, method=CSATrans.project_cross_kv)
         mem_len = pool.src_mask.shape[1]
-        t_cap = pool.toks.shape[1]
         b = batch.src_seq.shape[0]
 
         smask = batch.src_seq == PAD  # (b, n)
@@ -155,17 +155,81 @@ def build_prefill(model: CSATrans, spec: PrefillSpec):
             }
         return SlotPool(
             cache=cache,
-            src_mask=pool.src_mask.at[slot_ids].set(smask, mode="drop"),
-            tok=pool.tok.at[slot_ids].set(
-                jnp.full((b, 1), BOS, jnp.int32), mode="drop"),
-            pos=pool.pos.at[slot_ids].set(0, mode="drop"),
-            limit=pool.limit.at[slot_ids].set(
-                jnp.minimum(limits.astype(jnp.int32), t_cap), mode="drop"),
-            done=pool.done.at[slot_ids].set(False, mode="drop"),
-            prev_pad=pool.prev_pad.at[slot_ids].set(
-                jnp.zeros((b, t_cap), bool), mode="drop"),
-            toks=pool.toks.at[slot_ids].set(
-                jnp.full((b, t_cap), PAD, jnp.int32), mode="drop"),
+            **admit_slot_state(pool, slot_ids, limits, smask, b),
+        )
+
+    return prefill
+
+
+def build_paged_prefill(model: CSATrans, spec: PrefillSpec, geo):
+    """→ ``prefill(params, batch, slot_ids, limits, self_rows, cross_chain,
+    sample_key, pool) -> pool`` for the block-paged pool
+    (``serve/pages.py``), one AOT-compiled program per occupied bucket.
+
+    Same encoder-at-bucket-capacity math as :func:`build_prefill`; the
+    scatter targets differ.  Per batch row: the per-layer cross K/V
+    ``(H, n, dh)`` is zero-padded to this bucket's whole-page width
+    ``cpn * page`` and scattered page-by-page into ``cross_chain`` (b, cpn)
+    — page ids carry an out-of-range sentinel on padding rows, which
+    ``mode="drop"`` discards, so a ragged group never mints a program and
+    never writes a page it does not own.  Freshly allocated self pages
+    (``self_rows``, (b, SP), NULL-padded beyond each request's budget
+    chain) are scrubbed to zero — a freed page may carry a NaN-poisoned
+    predecessor's values, and even a 0-weight NaN lane poisons softmax
+    output; NULL padding entries just re-zero the null page.  Page-table
+    rows, the pad mask, and the reset decode state (BOS, position 0,
+    budget) land via the same slot-id drop-scatters as the rectangle path.
+    """
+    from csat_tpu.serve.pages import NULL_PAGE, PagedPool
+
+    n = spec.n
+    page = geo.page
+    cpn = geo.cross_pages(n)  # whole-page cross width for this bucket
+
+    def prefill(params, batch: Batch, slot_ids, limits, self_rows,
+                cross_chain, sample_key, pool: PagedPool) -> PagedPool:
+        memory, _, _, _, _ = model.apply(
+            {"params": params}, batch, method=CSATrans.encode,
+            rngs={"sample": sample_key},
+        )
+        cross = model.apply({"params": params}, memory, method=CSATrans.project_cross_kv)
+        mem_len = pool.src_mask.shape[1]
+        b = batch.src_seq.shape[0]
+
+        smask = batch.src_seq == PAD  # (b, n)
+        smask = jnp.pad(smask, ((0, 0), (0, mem_len - n)), constant_values=True)
+
+        flat_chain = cross_chain.reshape(-1)        # (b * cpn,)
+        scrub = self_rows.reshape(-1)               # NULL entries hit page 0
+        # table rows at pool width: chain ids, NULL beyond (and on sentinel
+        # padding rows — those rows are dropped by the slot-id scatter)
+        np_ = pool.pages[next(iter(pool.pages))]["k"].shape[0]
+        cross_rows = jnp.where(cross_chain >= np_, NULL_PAGE, cross_chain)
+        cross_rows = jnp.pad(cross_rows, ((0, 0), (0, geo.cp - cpn)),
+                             constant_values=NULL_PAGE)
+
+        def paginate(x):
+            """(b, H, n, dh) → (b * cpn, H, page, dh) whole-page blocks."""
+            x = jnp.pad(x, ((0, 0), (0, 0), (0, cpn * page - n), (0, 0)))
+            bb, h, _, dh = x.shape
+            x = x.reshape(bb, h, cpn, page, dh).transpose(0, 2, 1, 3, 4)
+            return x.reshape(bb * cpn, h, page, dh)
+
+        pages = {}
+        for layer, entry in pool.pages.items():
+            pages[layer] = {
+                "k": entry["k"].at[scrub].set(0.0)
+                                .at[flat_chain].set(paginate(cross[layer]["k"]),
+                                                    mode="drop"),
+                "v": entry["v"].at[scrub].set(0.0)
+                                .at[flat_chain].set(paginate(cross[layer]["v"]),
+                                                    mode="drop"),
+            }
+        return PagedPool(
+            pages=pages,
+            self_pt=pool.self_pt.at[slot_ids].set(self_rows, mode="drop"),
+            cross_pt=pool.cross_pt.at[slot_ids].set(cross_rows, mode="drop"),
+            **admit_slot_state(pool, slot_ids, limits, smask, b),
         )
 
     return prefill

@@ -37,9 +37,9 @@ from typing import Any, Dict, NamedTuple
 import jax.numpy as jnp
 
 from csat_tpu.models import CSATrans
-from csat_tpu.utils import EOS, PAD
+from csat_tpu.utils import BOS, EOS, PAD
 
-__all__ = ["SlotPool", "init_pool", "build_decode_step"]
+__all__ = ["SlotPool", "admit_slot_state", "init_pool", "build_decode_step"]
 
 
 class SlotPool(NamedTuple):
@@ -73,6 +73,31 @@ def init_pool(model: CSATrans, variables: Any, num_slots: int, steps: int,
         prev_pad=jnp.zeros((num_slots, steps), dtype=bool),
         toks=jnp.full((num_slots, steps), PAD, dtype=jnp.int32),
     )
+
+
+def admit_slot_state(pool, slot_ids, limits, smask, b: int) -> Dict[str, Any]:
+    """The seven decode-state leaves EVERY admission path resets — rect
+    prefill, paged prefill, and the prefix-cache attach program — scattered
+    at ``slot_ids`` with out-of-range sentinel rows dropped.  One shared
+    definition so the admission-state contract (BOS start token, position
+    0, ``t_cap``-clamped budget, cleared done/prev_pad/toks) cannot drift
+    between layouts and break the paged-vs-rect bit-identity the tests pin.
+    Works on :class:`SlotPool` and the paged pool alike (same field names);
+    callers add their layout-specific KV leaves."""
+    t_cap = pool.toks.shape[1]
+    return {
+        "src_mask": pool.src_mask.at[slot_ids].set(smask, mode="drop"),
+        "tok": pool.tok.at[slot_ids].set(
+            jnp.full((b, 1), BOS, jnp.int32), mode="drop"),
+        "pos": pool.pos.at[slot_ids].set(0, mode="drop"),
+        "limit": pool.limit.at[slot_ids].set(
+            jnp.minimum(limits.astype(jnp.int32), t_cap), mode="drop"),
+        "done": pool.done.at[slot_ids].set(False, mode="drop"),
+        "prev_pad": pool.prev_pad.at[slot_ids].set(
+            jnp.zeros((b, t_cap), bool), mode="drop"),
+        "toks": pool.toks.at[slot_ids].set(
+            jnp.full((b, t_cap), PAD, jnp.int32), mode="drop"),
+    }
 
 
 def build_decode_step(model: CSATrans):

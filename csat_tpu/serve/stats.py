@@ -52,6 +52,14 @@ class ServeStats:
         self.decode_steps = 0      # engine ticks that ran the decode program
         self.prefill_calls = 0
         self.gen_tokens = 0        # real tokens delivered to finished requests
+        # block-paged KV pool + prefix cache (serve/pages.py, serve/prefix.py)
+        self.prefix_hits = 0       # admissions that skipped prefill entirely
+        self.prefix_misses = 0     # cache-enabled admissions that encoded
+        self.pages_usable = 0      # allocatable pages (0 = rectangle layout)
+        self.rect_pages_per_slot = 0  # equal-memory yardstick (SP + CP)
+        self.page_peak = 0         # high-water pages in use
+        self._page_sum = 0         # Σ per-tick pages in use (mean occupancy)
+        self._page_samples = 0
         self.wait_s: Deque[float] = deque(maxlen=LATENCY_WINDOW)     # submit → admit
         self.latency_s: Deque[float] = deque(maxlen=LATENCY_WINDOW)  # submit → done
         self.first_done_t: Optional[float] = None
@@ -62,6 +70,18 @@ class ServeStats:
 
     def record_compile(self, kind: str, detail: Tuple) -> None:
         self.compile_events.append((kind, tuple(detail)))
+
+    def set_page_info(self, usable: int, rect_pages_per_slot: int) -> None:
+        """Paged-pool geometry (engine init / reset): enables the page
+        occupancy and effective-slots lines in :meth:`summary`."""
+        self.pages_usable = int(usable)
+        self.rect_pages_per_slot = int(rect_pages_per_slot)
+
+    def note_pages(self, used: int) -> None:
+        """One per-tick occupancy sample (pages currently allocated)."""
+        self.page_peak = max(self.page_peak, int(used))
+        self._page_sum += int(used)
+        self._page_samples += 1
 
     @property
     def compiles(self) -> int:
@@ -95,6 +115,18 @@ class ServeStats:
             t1 = self.last_done_t
             wall_s = (t1 - t0) if (t0 is not None and t1 is not None) else 0.0
         tps = self.gen_tokens / wall_s if wall_s > 0 else 0.0
+        # paged-pool accounting: mean/peak occupancy over the tick samples,
+        # the prefill-skip rate, and how many concurrent slots this pool
+        # offers per RECTANGLE slot's worth of KV memory (1.0 for the
+        # rectangle layout; 2.0 = the 2x-slots-at-equal-memory claim)
+        usable = self.pages_usable
+        occ = (self._page_sum / self._page_samples / usable
+               if usable and self._page_samples else 0.0)
+        peak = self.page_peak / usable if usable else 0.0
+        planned = self.prefix_hits + self.prefix_misses
+        hit_rate = self.prefix_hits / planned if planned else 0.0
+        eff = (self.num_slots * self.rect_pages_per_slot / usable
+               if usable else 1.0)
         return {
             "num_slots": self.num_slots,
             "submitted": self.submitted,
@@ -119,4 +151,9 @@ class ServeStats:
             "latency_p95_s": round(percentile(self.latency_s, 95), 4),
             "wait_p50_s": round(percentile(self.wait_s, 50), 4),
             "wait_p95_s": round(percentile(self.wait_s, 95), 4),
+            "kv_pages": usable,
+            "kv_page_occupancy": round(occ, 4),
+            "kv_page_peak": round(peak, 4),
+            "prefix_hit_rate": round(hit_rate, 4),
+            "effective_slots": round(eff, 3),
         }

@@ -38,6 +38,15 @@ def _mgr(directory: str) -> ocp.CheckpointManager:
 # whole device for the d2h + serialize time). Trainer waits at fit() end.
 _ASYNC_MANAGERS: dict = {}
 
+# Durability ledger: directory → (step, host_state) of the newest async save
+# whose background commit has NOT yet been confirmed. The train step DONATES
+# its state buffers, so when a background serialize/commit fault surfaces at
+# the durability barrier the device state that produced the snapshot no
+# longer exists — the saver must own the host copy until the commit is
+# confirmed, so the barrier can RETRY the save instead of losing the epoch
+# (ROADMAP resilience carryover). Dropped as soon as a barrier passes.
+_PENDING_SAVES: dict = {}
+
 
 def _mgr_async(directory: str) -> ocp.CheckpointManager:
     d = os.path.abspath(directory)
@@ -102,20 +111,57 @@ def save_state_async(directory: str, state: TrainState, step: int) -> None:
     long-AST configs run near capacity).
 
     Durability contract: the save is durable only after
-    :func:`wait_for_saves` (Trainer calls it at the end of ``fit``; orbax
-    also drains the previous in-flight save before accepting a new one, so
-    at most the LAST snapshot can be lost to a hard kill — one
-    ``save_interval`` of resume window, never a corrupt checkpoint: orbax
-    commits steps atomically).
+    :func:`wait_for_saves`.  The host copy is retained in the durability
+    ledger until that barrier confirms the commit, so a fault in the
+    BACKGROUND half — which used to surface unretried at the barrier,
+    after the donated device state was already gone — now retries the save
+    synchronously from the retained copy.  Draining the previous save
+    happens through the same barrier, so a deferred epoch-N-1 failure is
+    recovered here before epoch N's save is submitted.  At most the LAST
+    snapshot can be lost to a hard kill — one ``save_interval`` of resume
+    window, never a corrupt checkpoint: orbax commits steps atomically.
     """
-    _mgr_async(directory).save(step, args=ocp.args.StandardSave(_to_host(state)))
+    d = os.path.abspath(directory)
+    m = _mgr_async(d)
+    host_state = _to_host(state)
+    # confirm (or recover) the PREVIOUS save before replacing its ledger
+    # entry — orbax would drain it inside save() anyway, but through this
+    # barrier a deferred background fault gets the retry-from-host-copy
+    # path instead of propagating with the state unrecoverable
+    _confirm_durable(d, m)
+    _PENDING_SAVES[d] = (step, host_state)
+    m.save(step, args=ocp.args.StandardSave(host_state))
+
+
+def _confirm_durable(d: str, m) -> None:
+    """Durability barrier for one directory: wait for the in-flight async
+    save; on a background serialize/commit fault, retry ONCE synchronously
+    from the ledger's host copy (the device original was donated away).
+    A second failure propagates — that is a broken filesystem, not a blip.
+    The ledger entry is dropped only on confirmed durability."""
+    import sys
+
+    try:
+        m.wait_until_finished()
+    except Exception as e:  # noqa: BLE001 — deferred background fault
+        pending = _PENDING_SAVES.get(d)
+        if pending is None:
+            raise
+        step, host_state = pending
+        print(f"# checkpoint: async save of step {step} to {d} failed at "
+              f"the durability barrier ({type(e).__name__}: {e}); retrying "
+              "synchronously from the retained host copy", file=sys.stderr)
+        m.save(step, args=ocp.args.StandardSave(host_state))
+        m.wait_until_finished()
+    _PENDING_SAVES.pop(d, None)
 
 
 def wait_for_saves(directory: Optional[str] = None) -> None:
-    """Block until pending async snapshots are durable (all dirs, or one)."""
+    """Block until pending async snapshots are durable (all dirs, or one);
+    a background commit fault is retried from the retained host copy."""
     for d, m in list(_ASYNC_MANAGERS.items()):
         if directory is None or d == os.path.abspath(directory):
-            m.wait_until_finished()
+            _confirm_durable(d, m)
 
 
 def _to_host(tree: Any) -> Any:

@@ -197,6 +197,10 @@ def make_train_step(
         return _Lowered(_step.lower(state, batch, b, s))
 
     train_step.lower = lower
+    # compile-event hook: how many distinct programs jit built for this
+    # step — the single-compile-per-fit regression tripwire (the cold-start
+    # double compile was exactly this counter reading 2; tests/test_train.py)
+    train_step.cache_size = _step._cache_size
     return train_step
 
 
@@ -452,6 +456,20 @@ class Trainer:
         self.fault_injector = None
         self.watchdog_on_timeout = None
 
+    def _commit(self, state: TrainState) -> TrainState:
+        """Commit a host-built state to the mesh (fully replicated).
+
+        ``jax.jit`` specializes on argument shardings: a freshly-initialized
+        (or checkpoint-restored, or rollback-restored) state is uncommitted,
+        while every step OUTPUT is mesh-committed — so an uncommitted state
+        entering the step compiled the SAME program a second time (~12s each
+        on the CPU box, verified via JAX_LOG_COMPILES in PR 4; ROADMAP
+        cold-start item a).  One device_put before the first step makes fit
+        compile once, asserted via ``train_step.cache_size`` in
+        tests/test_train.py."""
+        return jax.device_put(state, jax.sharding.NamedSharding(
+            self.mesh, jax.sharding.PartitionSpec()))
+
     def init_state(self, example: Batch) -> TrainState:
         state = create_train_state(self.model, self.tx, example, self.cfg.seed)
         if self.initial_params is not None:
@@ -689,6 +707,9 @@ class Trainer:
                     best_bleu = float(json.load(f).get("bleu", 0.0))
         else:
             resumed = False
+        # one compile per fit, not two: see _commit (every resume path above
+        # rebuilds the state from host arrays, so commit AFTER the branch)
+        state = self._commit(state)
         eval_key = jax.random.key(cfg.seed + 777)
         history: Dict[str, Any] = {
             "loss": [], "val_bleu": [], "best_bleu": best_bleu,
@@ -709,7 +730,18 @@ class Trainer:
             budget if (cfg.data_error_budget > 0 or injector is not None)
             else None)
         global_step = 0   # train-step attempts this fit — fault ordinals
-        bad_dev = None    # device-side consecutive-non-finite counter
+        # device-side consecutive-non-finite counter. Starts as a COMMITTED
+        # zero (not None→fresh-scalar): the step's own output is committed,
+        # and jit specializes on operand shardings, so an uncommitted first
+        # scalar would compile the step a second time (same mechanism as
+        # the state commitment in _commit)
+        def _zero_bad():
+            return jax.device_put(
+                jnp.zeros((), jnp.int32),
+                jax.sharding.NamedSharding(
+                    self.mesh, jax.sharding.PartitionSpec()))
+
+        bad_dev = _zero_bad()
 
         with contextlib.ExitStack() as stack:
             if cfg.preempt_save:
@@ -800,7 +832,11 @@ class Trainer:
                             injector.maybe_hang(global_step)
                         state, metrics = self.program_cache(
                             state, batch, bad_steps=bad_dev, loss_scale=loss_scale)
-                        bad_dev = metrics.get("bad_steps")
+                        # guard-off steps emit no bad_steps: KEEP the
+                        # committed zero instead of degrading to None →
+                        # fresh uncommitted scalar → second compile (the
+                        # exact mechanism _commit/_zero_bad exist to stop)
+                        bad_dev = metrics.get("bad_steps", bad_dev)
                         it_done += 1
                         if watchdog is not None:
                             watchdog.beat()
@@ -861,9 +897,11 @@ class Trainer:
                                         f"after {history['rollbacks']} rollbacks "
                                         f"(epoch {epoch} it {it}) — aborting")
                                 history["rollbacks"] += 1
-                                state = restore_snapshot(
-                                    snapshot, resplit=history["rollbacks"])
-                                bad_dev = None
+                                # snapshots live on host — recommit so the
+                                # replay reuses the compiled step program
+                                state = self._commit(restore_snapshot(
+                                    snapshot, resplit=history["rollbacks"]))
+                                bad_dev = _zero_bad()
                                 rolled_back = True
                                 # replay from the snapshot's position: the
                                 # whole epoch when the anchor is the epoch

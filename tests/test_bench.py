@@ -95,6 +95,37 @@ def test_dead_probe_falls_back_to_cpu_specs(bench, monkeypatch, capsys):
     assert "tpu_probe" in out and "timeout" in out["tpu_probe"]
 
 
+def test_serve_record_paging_fields_survive_embedding(bench, monkeypatch, capsys):
+    """A serve-mode child record's paged-KV fields (equal-memory slot
+    ratio, page occupancy, prefix-cache hit rate) must survive into the
+    final JSON's all_variants — they carry the 2x-slots-at-equal-memory
+    bench claim (ISSUE 6)."""
+    paged_fields = {"engine_slots": 8, "effective_slots": 2.0,
+                    "kv_page_occupancy": 0.61, "prefix_hit_rate": 0.25}
+
+    def fake_child(args, timeout_s, cpu_only=False):
+        if args[0] == "--probe":
+            return {"ok": True, "platform": "tpu", "n_devices": 1}, None
+        for spec in args[1].split(","):
+            _emit(bench, {"phase": "start", "spec": spec})
+            rec = _result(spec, 100.0)
+            if rec["mode"] == "serve":
+                rec.update(paged_fields, num_slots=4,
+                           gen_tokens_per_sec_per_chip=500.0,
+                           vs_batch_decode=1.7)
+            _emit(bench, rec)
+        _emit(bench, {"phase": "done"})
+        return {"ok": True, "phase": "done"}, None
+
+    monkeypatch.setattr(bench, "_run_child", fake_child)
+    out = _run_main(bench, capsys)
+    serve_recs = [v for v in out["all_variants"] if v["mode"] == "serve"]
+    assert serve_recs, "spec list must carry a serve variant"
+    for v in serve_recs:
+        for k, want in paged_fields.items():
+            assert v[k] == want, (k, v)
+
+
 def test_killed_serve_retries_untried_first(bench, monkeypatch, capsys):
     """A serve child killed mid-variant: the retry round runs the missing
     specs with the killed one LAST, and the final JSON carries both the

@@ -1,0 +1,346 @@
+"""Block-paged KV pool + cross-request prefix cache (ISSUE 6 tentpole).
+
+Pins the allocation subsystem's contracts:
+
+* **allocator invariants** — all-or-nothing alloc from the free list, no
+  page handed out twice (aliasing), no double-free, exact conservation
+  (free + used == usable) under randomized alloc/free sequences;
+* **prefix refcounts** — a cache-owned chain is pinned while ANY live slot
+  shares it and becomes evictable exactly when the last sharer retires;
+  eviction never touches a referenced entry;
+* **exactness** — the paged engine's outputs are bit-identical to the
+  rectangle slot pool's (and, transitively via ``tests/test_serve.py``, to
+  fresh ``greedy_decode``) on deterministic configs, INCLUDING requests
+  admitted through a prefix-cache hit that never ran prefill;
+* **no leaks** — after any drained trace (randomized budgets, duplicate
+  storms, shed_all, page backpressure) every allocated page is either free
+  or accounted to the prefix cache: ``used == pinned``;
+* **rebuild hygiene** — a pool rebuild after a device fault resets the
+  free list and clears the cache in the same breath: zero pinned pages,
+  zero used pages, and the resubmitted requests still come back exact;
+* **compile discipline** — a warm paged engine (hits and misses both)
+  replays a trace with ZERO new compiles.
+"""
+
+import numpy as np
+import pytest
+
+from csat_tpu.data.toy import random_request_sample
+from csat_tpu.resilience import FaultInjector
+from csat_tpu.serve import RequestStatus, ServeEngine
+from csat_tpu.serve.pages import (
+    NULL_PAGE,
+    PageAllocator,
+    chain_table_row,
+    page_geometry,
+)
+from csat_tpu.serve.prefix import PrefixCache, sample_hash
+
+SRC_V, TGT_V, TRIP_V = 200, 300, 50
+
+
+# ---------------------------------------------------------------------------
+# geometry + allocator (host-only, no jax)
+# ---------------------------------------------------------------------------
+
+
+def test_page_geometry_math(micro_config):
+    cfg = micro_config.replace(serve_slots=4, serve_page_size=16)
+    geo = page_geometry(cfg)
+    assert geo.page == 16
+    assert geo.steps == cfg.max_tgt_len - 1
+    assert geo.mem_len == cfg.max_src_len
+    assert geo.sp == -(-geo.steps // 16)
+    assert geo.cp == -(-geo.mem_len // 16)
+    # auto-size = every slot's worst-case chain + the null page: exactly
+    # the rectangle pool's memory, zero admission stalls
+    assert geo.num_pages == 1 + 4 * (geo.sp + geo.cp)
+    assert geo.usable == geo.num_pages - 1
+    assert geo.rect_pages_per_slot == geo.sp + geo.cp
+    # ceil funding, never zero pages (a 0-budget chain still owns a page)
+    assert geo.self_pages(1) == 1
+    assert geo.self_pages(16) == 1
+    assert geo.self_pages(17) == 2
+    assert geo.cross_pages(0) == 1
+    # explicit serve_num_pages overrides the auto-size
+    assert page_geometry(cfg.replace(serve_num_pages=9)).num_pages == 9
+
+
+def test_chain_table_row_null_padded():
+    row = chain_table_row([5, 2, 9], 6)
+    assert row.dtype == np.int32
+    assert list(row) == [5, 2, 9, NULL_PAGE, NULL_PAGE, NULL_PAGE]
+
+
+def test_allocator_randomized_alloc_free_invariants():
+    """Randomized alloc/free storm: all-or-nothing allocation, disjoint
+    chains (no aliasing), exact conservation, full reclaim at the end."""
+    rng = np.random.default_rng(0)
+    alloc = PageAllocator(64)
+    live = {}  # tag -> chain
+    for step in range(2000):
+        if live and (rng.random() < 0.45 or alloc.free_pages == 0):
+            tag = list(live)[int(rng.integers(len(live)))]
+            alloc.free(live.pop(tag))
+        else:
+            n = int(rng.integers(1, 7))
+            chain = alloc.alloc(n)
+            if chain is None:
+                # all-or-nothing: a refused alloc changed nothing
+                assert n > alloc.free_pages
+            else:
+                assert len(chain) == n
+                assert NULL_PAGE not in chain
+                taken = set().union(*live.values()) if live else set()
+                assert not taken & set(chain), "page aliased across chains"
+                live[step] = chain
+        held = sum(len(c) for c in live.values())
+        assert alloc.used_pages == held
+        assert alloc.free_pages + alloc.used_pages == alloc.usable
+    for chain in live.values():
+        alloc.free(chain)
+    assert alloc.free_pages == alloc.usable and alloc.used_pages == 0
+
+
+def test_allocator_double_free_and_null_page_guards():
+    alloc = PageAllocator(8)
+    chain = alloc.alloc(3)
+    alloc.free(chain)
+    with pytest.raises(AssertionError):
+        alloc.free(chain)  # double-free
+    with pytest.raises(AssertionError):
+        alloc.free([NULL_PAGE])  # the reserved null page is never owned
+    with pytest.raises(AssertionError):
+        PageAllocator(1)  # nothing allocatable beside the null page
+
+
+# ---------------------------------------------------------------------------
+# prefix cache refcounts (host-only)
+# ---------------------------------------------------------------------------
+
+
+def test_prefix_refcount_pins_until_last_sharer_releases():
+    cache = PrefixCache(capacity=4)
+    h = b"h" * 16
+    assert cache.insert(h, [3, 4]) == []  # took ownership, refs=1 (inserter)
+    assert cache.acquire(h).refs == 2    # a second concurrent sharer
+    # both sharers live: the entry is pinned — no eviction path may touch it
+    assert cache.evict_for(10) == []
+    assert cache._evict_one() is None
+    cache.release(h)
+    assert cache.evict_for(10) == []     # one sharer still live
+    cache.release(h)                     # last sharer retires
+    assert cache.pinned_pages == 2       # pinned for the NEXT identical submit
+    assert cache.evict_for(1) == [[3, 4]]  # …and only now evictable
+    assert len(cache) == 0 and cache.pinned_pages == 0
+
+
+def test_prefix_lru_eviction_and_declined_insert():
+    cache = PrefixCache(capacity=2)
+    cache.insert(b"a", [1]); cache.release(b"a")
+    cache.insert(b"b", [2]); cache.release(b"b")
+    cache.acquire(b"a")  # touch: b becomes LRU
+    assert cache.insert(b"c", [3]) == [[2]]  # b evicted, a (referenced) kept
+    assert cache.insert(b"c", [9]) is None   # duplicate hash: declined
+    cache.release(b"c")
+    # capacity full of referenced entries: insert declined, cache not grown
+    cache.acquire(b"c")
+    assert cache.insert(b"d", [4]) is None
+    assert len(cache) == 2
+    cache.clear()
+    assert len(cache) == 0 and cache.acquire(b"a") is None
+
+
+def test_sample_hash_is_content_only(micro_config):
+    s1 = random_request_sample(micro_config, SRC_V, TRIP_V, 9, seed=3)
+    s2 = {k: np.array(v) for k, v in s1.items()}  # fresh buffers, same bytes
+    s3 = random_request_sample(micro_config, SRC_V, TRIP_V, 9, seed=4)
+    assert sample_hash(s1) == sample_hash(s2)
+    assert sample_hash(s1) != sample_hash(s3)
+
+
+# ---------------------------------------------------------------------------
+# engine-level drills (paged vs rect, sharing, leaks, rebuild)
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def paged_cfg(micro_config):
+    """Deterministic micro config (bit-identity paths), flagship-only
+    prefill ladder, 4-slot pool, page size 4 so micro lengths span
+    multi-page chains."""
+    return micro_config.replace(
+        full_att=True, dropout=0.0, attention_dropout=0.0,
+        cse_empty_rows="zero", serve_slots=4, bucket_src_lens=(48,),
+        serve_page_size=4)
+
+
+@pytest.fixture(scope="module")
+def pair(paged_cfg):
+    """(cfg, model, params, paged_engine, rect_engine) over one shared
+    model — the A/B pair for every exactness assertion below.  The paged
+    engine runs a DELIBERATELY tight pool (half the slots' worst case) so
+    the drills cross the backpressure and eviction paths."""
+    from csat_tpu.serve.prefill import collate_requests
+    from csat_tpu.train.state import create_train_state, default_optimizer, make_model
+
+    cfg = paged_cfg
+    model = make_model(cfg, SRC_V, TGT_V, TRIP_V)
+    warm = collate_requests(
+        [random_request_sample(cfg, SRC_V, TRIP_V, 8, seed=0)],
+        cfg.max_src_len, 1, cfg, tgt_width=cfg.max_tgt_len - 1)
+    params = create_train_state(
+        model, default_optimizer(cfg), warm, seed=0).params
+    geo = page_geometry(cfg)
+    tight = cfg.replace(
+        serve_num_pages=1 + cfg.serve_slots * geo.rect_pages_per_slot // 2)
+    paged = ServeEngine(model, params, tight, sample_seed=1)
+    rect = ServeEngine(model, params,
+                       cfg.replace(serve_kv_layout="rect", serve_prefix_cache=0),
+                       sample_seed=1)
+    yield cfg, model, params, paged, rect
+    paged.close()
+    rect.close()
+
+
+def _trace(cfg, n, seed, dup_every=3):
+    """Mixed-length requests with every ``dup_every``-th an exact repeat of
+    an earlier one (the near-duplicate-code workload)."""
+    rng = np.random.default_rng(seed)
+    samples = [
+        random_request_sample(cfg, SRC_V, TRIP_V, int(ln), seed=500 * seed + i)
+        for i, ln in enumerate(rng.integers(5, cfg.max_src_len, n))
+    ]
+    for i in range(dup_every - 1, n, dup_every):
+        samples[i] = samples[int(rng.integers(0, i))]
+    return samples
+
+
+def _no_leaks(engine):
+    """Drained-pool accounting: every allocated page is cache-owned."""
+    assert engine.occupancy == 0 and engine.queue_depth == 0
+    pinned = engine._prefix.pinned_pages if engine._prefix is not None else 0
+    assert engine._allocator.used_pages == pinned, (
+        f"leak: {engine._allocator.used_pages} pages used, "
+        f"{pinned} accounted to the prefix cache")
+    assert all(m is None for m in engine._slot_meta)
+
+
+def test_paged_bit_identical_to_rect_including_prefix_hits(pair):
+    """Same oversubscribed duplicate-laden trace through both layouts:
+    token-for-token identical, with the paged engine serving some
+    admissions straight from the prefix cache (no prefill)."""
+    cfg, _, _, paged, rect = pair
+    samples = _trace(cfg, 3 * cfg.serve_slots, seed=2)
+    budgets = [0, 3, 5] * cfg.serve_slots
+    a = [paged.submit(s, max_new_tokens=b) for s, b in zip(samples, budgets)]
+    b = [rect.submit(s, max_new_tokens=bb) for s, bb in zip(samples, budgets)]
+    paged.drain()
+    rect.drain()
+    assert paged.stats.prefix_hits > 0, "trace must exercise the hit path"
+    for ia, ib in zip(a, b):
+        ra, rb = paged.pop_result(ia), rect.pop_result(ib)
+        assert ra.status == rb.status == RequestStatus.OK
+        np.testing.assert_array_equal(ra.tokens, rb.tokens)
+    _no_leaks(paged)
+
+
+def test_shared_chain_refs_track_live_sharers(pair):
+    """Three concurrent identical submissions: one chain, refs == live
+    sharers while decoding, unpinned (but cached) when the last retires."""
+    cfg, _, _, paged, _ = pair
+    dup = random_request_sample(cfg, SRC_V, TRIP_V, 11, seed=77)
+    h = sample_hash(dup)
+    id0 = paged.submit(dup)
+    t = 0
+    while h not in paged._prefix._entries:
+        paged.tick()  # the first submission prefills + publishes the chain
+        t += 1
+        assert t < 30, "chain never published"
+    # two more identical submissions AFTER publication: both must hit
+    ids = [id0, paged.submit(dup), paged.submit(dup)]
+    paged.tick()  # the hits attach (no prefill)
+    entry = paged._prefix._entries[h]
+    live = sum(1 for r in paged._slots
+               if r is not None and r.id in set(ids))
+    assert entry.refs == live > 0
+    shared = {tuple(paged._slot_meta[r.slot].cross_chain)
+              for r in paged._slots if r is not None and r.id in set(ids)}
+    assert shared == {tuple(entry.chain)}, "sharers must use ONE chain"
+    paged.drain()
+    assert entry.refs == 0, "every sharer retired — nothing still pinned"
+    assert paged._prefix._entries.get(h) is entry, "chain stays cached"
+    for i in ids:
+        paged.pop_result(i)
+    _no_leaks(paged)
+
+
+def test_randomized_admit_retire_shed_storm_no_leak(pair):
+    """Randomized submit/tick/shed storm on the HALF-SIZE pool (constant
+    backpressure + forced evictions): the allocator's own aliasing /
+    double-free assertions arm every step, and the drained pool accounts
+    for every page."""
+    cfg, _, _, paged, _ = pair
+    rng = np.random.default_rng(9)
+    ids = []
+    for round_ in range(6):
+        for s in _trace(cfg, int(rng.integers(2, 7)), seed=20 + round_):
+            ids.append(paged.submit(s, max_new_tokens=int(rng.integers(0, 8))))
+        for _ in range(int(rng.integers(1, 5))):
+            paged.tick()
+        if round_ == 3:
+            paged.shed_all(reason="storm drill")
+    paged.drain()
+    statuses = {paged.pop_result(i).status for i in ids}
+    assert statuses <= {RequestStatus.OK, RequestStatus.SHED}
+    _no_leaks(paged)
+
+
+def test_rebuild_after_device_fault_zero_pinned_pages(pair):
+    """A decode-dispatch fault mid-flight: the rebuild must reset the free
+    list and drop every prefix refcount together — zero used, zero pinned
+    — then the resubmitted requests complete exactly."""
+    cfg, model, params, paged, rect = pair
+    samples = _trace(cfg, 6, seed=31)
+    # fault ticks are absolute engine ticks; the module-shared engine has
+    # already ticked through earlier tests
+    paged.fault_injector = FaultInjector(
+        serve_decode_fail_ticks=[paged._tick_no + 2])
+    try:
+        ids = [paged.submit(s) for s in samples]
+        t = 0
+        while paged.stats.rebuilds == 0:
+            paged.tick()
+            t += 1
+            assert t < 50, "injected decode fault never fired"
+        # the faulting tick just rebuilt: fresh free list, cleared cache,
+        # in-flight work requeued (admission happens on the NEXT tick)
+        assert paged._allocator.used_pages == 0
+        assert paged._prefix.pinned_pages == 0 and len(paged._prefix) == 0
+        assert all(m is None for m in paged._slot_meta)
+        paged.drain()
+    finally:
+        paged.fault_injector = None
+        paged._rebuilds = 0
+    rb = [rect.submit(s) for s in samples]
+    rect.drain()
+    for ia, ib in zip(ids, rb):
+        ra = paged.pop_result(ia)
+        assert ra.status == RequestStatus.OK
+        np.testing.assert_array_equal(ra.tokens, rect.pop_result(ib).tokens)
+    _no_leaks(paged)
+
+
+def test_paged_steady_state_zero_recompiles(pair):
+    """Fast gate: a warm paged engine replays a duplicate-laden trace —
+    hits through attach, misses through prefill, multi-page chains — with
+    ZERO new compiled programs (the serving-regression tripwire, now over
+    the paged layout)."""
+    cfg, _, _, paged, _ = pair
+    before = paged.stats.compiles
+    for r in paged.generate(_trace(cfg, 2 * cfg.serve_slots, seed=41)):
+        assert r.status == RequestStatus.OK
+    assert paged.stats.prefix_hits > 0
+    assert paged.stats.compiles == before, (
+        "steady-state recompile with paging enabled")
+    _no_leaks(paged)

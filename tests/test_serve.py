@@ -248,7 +248,14 @@ def test_poisson_trace_beats_batch_at_a_time_decode(served):
         for i in range(n_req)
     ]
 
-    # warm both paths before timing
+    # warm both paths before timing — one request pinned at EVERY prefill
+    # bucket's capacity (the skewed trace's first few samples may all land
+    # in bucket 0, and a standalone `-m slow` run has no earlier fast test
+    # to warm bucket 1: a mid-trace ~10s compile would swamp the ~2s trace)
+    engine.generate(
+        [random_request_sample(cfg, SRC_V, TRIP_V, spec.n, seed=10 + i)
+         for i, spec in enumerate(engine.specs)],
+        max_new_tokens=1)
     engine.generate(samples[: cfg.serve_slots], max_new_tokens=1)
     decode = jax.jit(lambda p, b, k: greedy_decode(model, {"params": p}, b, k))
     warm_b = collate_requests(samples[:cfg.serve_slots], cfg.max_src_len,

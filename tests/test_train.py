@@ -24,6 +24,13 @@ def test_train_smoke(synthetic_corpus, tiny_config):
     train_ds = ASTDataset(cfg, "train", trainer.src_vocab, trainer.tgt_vocab)
     state, history = trainer.fit(train_ds, None)
     assert np.isfinite(history["loss"][-1])
+    # cold-start contract (ROADMAP item a): fit compiles the train step
+    # exactly ONCE — the initial state is mesh-committed before step 1, so
+    # the step-1 output's committed sharding cannot force a second compile
+    # of the same program (~12s each on the CPU box before the fix)
+    assert trainer.train_step.cache_size() == 1, (
+        f"fit built {trainer.train_step.cache_size()} train-step programs; "
+        "the initial state must be mesh-committed so it compiles once")
     batch = next(iterate_batches(train_ds, 8, shuffle=False))
     out = np.asarray(
         greedy_decode(trainer.model, {"params": state.params}, batch, jax.random.key(0))
