@@ -203,8 +203,11 @@ def _measure_one(spec: str, heartbeat=None) -> dict:
         raise FloatingPointError(f"non-finite loss {loss}")
 
     t0 = time.perf_counter()
+    dispatch_s = 0.0
     for _ in range(n_steps):
+        t = time.perf_counter()
         state, metrics = step(state, batch)
+        dispatch_s += time.perf_counter() - t
     loss = float(jax.block_until_ready(metrics["loss"]))
     dt = time.perf_counter() - t0
 
@@ -234,6 +237,11 @@ def _measure_one(spec: str, heartbeat=None) -> dict:
         "peak_hbm_gb": round(peak / 2**30, 3),
         "nodes_per_sec_per_chip": nodes / dt / n_chips,
         "real_nodes_per_sec_per_chip": real_nodes / dt / n_chips,
+        # host-vs-device share of the timed loop: dispatch is the host-side
+        # enqueue cost, the remainder is spent waiting on the device (the
+        # async queue hides per-step waits until the final block)
+        "phase_time": {"dispatch_s": round(dispatch_s, 4),
+                       "device_wait_s": round(dt - dispatch_s, 4)},
         **xla_mem,
     }
 
@@ -451,7 +459,6 @@ def _measure_serve(backend: str, dtype: str, num_slots: int,
         heartbeat({"phase": "compiled", "compile_s": round(t_compile, 1),
                    "programs": compiles_warm})
 
-    engine.reset_stats()
     # saturating offered load (~1.4x the pool's service rate): a slot
     # retires every ~mean_budget decode steps, so arrivals at
     # mean_budget / slots / 1.4 keep a small queue standing — the
@@ -461,22 +468,74 @@ def _measure_serve(backend: str, dtype: str, num_slots: int,
     arrivals = np.cumsum(rng.exponential(
         scale=float(budgets.mean()) / max(cfg.serve_slots, 1) / 1.4,
         size=n_requests))  # decode-step units
-    t0 = time.perf_counter()
-    nxt = 0
-    ids = []
-    while nxt < n_requests or engine.occupancy or engine.queue_depth:
-        while nxt < n_requests and arrivals[nxt] <= engine.stats.decode_steps:
-            ids.append(engine.submit(samples[nxt],
-                                     max_new_tokens=int(budgets[nxt])))
-            nxt += 1
-        if not engine.tick() and nxt < n_requests:
-            # idle gap in the trace: jump the step clock to the next arrival
-            engine.stats.decode_steps = int(np.ceil(arrivals[nxt]))
-    engine_wall = time.perf_counter() - t0
-    reqs = [engine.poll(i) for i in ids]
+
+    def clear_prefix() -> None:
+        # the pool is drained (no live sharers): evict every cached chain
+        # so each timed run starts with a COLD prefix cache and sees the
+        # identical hit schedule
+        if engine._prefix is not None:
+            for chain in engine._prefix.evict_for(10 ** 9):
+                engine._allocator.free(chain)
+
+    def run_trace():
+        engine.reset_stats()
+        clear_prefix()
+        t0 = time.perf_counter()
+        nxt = 0
+        ids = []
+        while nxt < n_requests or engine.occupancy or engine.queue_depth:
+            while (nxt < n_requests
+                   and arrivals[nxt] <= engine.stats.decode_steps):
+                ids.append(engine.submit(samples[nxt],
+                                         max_new_tokens=int(budgets[nxt])))
+                nxt += 1
+            if not engine.tick() and nxt < n_requests:
+                # idle gap in the trace: jump the step clock to the arrival
+                engine.stats.decode_steps = int(np.ceil(arrivals[nxt]))
+        wall = time.perf_counter() - t0
+        return wall, [engine.poll(i) for i in ids]
+
+    # telemetry overhead A/B (ISSUE 7 acceptance): the SAME trace runs once
+    # with the flight recorder disabled and once with the production
+    # cheap-on telemetry; the headline number is the telemetry-ON run (what
+    # production serves with), the off run bounds the instrumentation tax
+    from csat_tpu.obs import EventRecorder, write_chrome_trace
+
+    pm_dir = engine._postmortem_dir
+    engine.obs, engine._postmortem_dir = EventRecorder(0, "serve"), ""
+    wall_off, reqs_off = run_trace()
+    tps_off = sum(r.n_tokens for r in reqs_off) / wall_off
+    # FRESH recorder for the measured run: the engine's init-time recorder
+    # saw the warm-up compiles, which would swamp the phase totals
+    engine.obs, engine._postmortem_dir = (
+        EventRecorder(cfg.obs_events, "serve"), pm_dir)
+    engine_wall, reqs = run_trace()
     useful = sum(r.n_tokens for r in reqs)
     lat = sorted(r.done_t - r.submit_t for r in reqs)
     assert engine.stats.compiles == compiles_warm, "steady-state recompile!"
+    tps_on = useful / engine_wall
+    overhead_pct = (1.0 - tps_on / tps_off) * 100.0 if tps_off > 0 else 0.0
+
+    # phase-time breakdown from the recorder's span totals (host clocks
+    # only): prefill vs decode dispatch vs device wait (status fetch) vs
+    # scheduler bookkeeping. tick.admit CONTAINS the prefill dispatches.
+    pt = engine.obs.totals
+    phase_time = {
+        "prefill_s": round(sum(
+            v for k, v in pt.items() if k.startswith("prefill.")), 4),
+        "admit_s": round(pt.get("tick.admit", 0.0), 4),
+        "retire_s": round(pt.get("tick.retire", 0.0), 4),
+        "decode_dispatch_s": round(pt.get("tick.decode_dispatch", 0.0), 4),
+        "device_wait_s": round(pt.get("tick.status_fetch", 0.0), 4),
+    }
+    trace_file = None
+    try:
+        trace_file = os.path.join(
+            HERE, "results", "perf", f"trace_serve_{backend}_{dtype}.json")
+        write_chrome_trace(trace_file, engine.obs)
+        trace_file = os.path.relpath(trace_file, HERE)
+    except Exception:  # noqa: BLE001 — the trace artifact is best-effort
+        trace_file = None
 
     # ---- batch-at-a-time greedy_decode baseline, same requests ----------
     decode = jax.jit(lambda p, b, k: greedy_decode(model, {"params": p}, b, k))
@@ -502,7 +561,7 @@ def _measure_serve(backend: str, dtype: str, num_slots: int,
     from csat_tpu.serve.stats import percentile
 
     n_chips = jax.device_count()
-    tps = useful / engine_wall / n_chips
+    tps = tps_on / n_chips
     base_tps = base_useful / base_wall / n_chips
     summ = engine.stats.summary(wall_s=engine_wall, n_chips=n_chips)
     return {
@@ -532,6 +591,13 @@ def _measure_serve(backend: str, dtype: str, num_slots: int,
         "gen_tokens_per_sec_per_chip": round(tps, 2),
         "batch_gen_tokens_per_sec_per_chip": round(base_tps, 2),
         "vs_batch_decode": round(tps / base_tps, 3) if base_tps > 0 else 0.0,
+        # telemetry overhead on the SAME trace (headline = telemetry ON;
+        # the acceptance bound is |overhead| within ~2%)
+        "telemetry_off_tps_per_chip": round(tps_off / n_chips, 2),
+        "telemetry_overhead_pct": round(overhead_pct, 2),
+        # host-clock phase attribution + the Perfetto-loadable span export
+        "phase_time": phase_time,
+        "trace_file": trace_file,
         "latency_p50_s": round(percentile(lat, 50), 4),
         "latency_p95_s": round(percentile(lat, 95), 4),
         # serving-resilience outcome counters (serve/stats.py): all zero on
@@ -936,7 +1002,10 @@ def main() -> None:
                                      "gen_tokens_per_sec_per_chip",
                                      "batch_gen_tokens_per_sec_per_chip",
                                      "vs_batch_decode", "latency_p50_s",
-                                     "latency_p95_s", "programs")
+                                     "latency_p95_s", "programs",
+                                     "telemetry_off_tps_per_chip",
+                                     "telemetry_overhead_pct", "phase_time",
+                                     "trace_file")
                    if k in r}
             # self-describing artifact (r4 verdict weak #6): pallas on CPU is
             # pl.pallas_call(interpret=True) — a correctness canary, not a

@@ -79,6 +79,13 @@ def _train_main() -> None:
     p.add_argument("--bucket_src_lens", default="",
                    help="comma list of bucket node capacities (default: "
                         "geometric ladder capped by max_src_len)")
+    p.add_argument("--scalar_log_every", type=int, default=-1,
+                   help="per-iteration scalars.jsonl cadence (0 = epoch "
+                        "records only; default keeps the config's value)")
+    p.add_argument("--metrics_file", default="",
+                   help="append JSONL training-metrics snapshots here "
+                        "(csat_tpu/obs/metrics.py format; written at each "
+                        "epoch boundary and after fit)")
     args = p.parse_args()
 
     if args.platform:
@@ -120,6 +127,10 @@ def _train_main() -> None:
     if args.bucket_src_lens:
         overrides["bucket_src_lens"] = tuple(
             int(v) for v in args.bucket_src_lens.split(","))
+    if args.scalar_log_every >= 0:
+        overrides["scalar_log_every"] = args.scalar_log_every
+    if args.metrics_file:
+        overrides["obs_metrics_file"] = args.metrics_file
     overrides["scalar_log"] = True  # the CLI always streams scalars.jsonl
     cfg = get_config(args.config, **overrides)
 

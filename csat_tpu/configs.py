@@ -290,6 +290,30 @@ class Config:
     # observability (cli --profile / scalars.jsonl stream; SURVEY §5)
     scalar_log: bool = False
     profile: bool = False
+    # --- unified telemetry (csat_tpu/obs/; ISSUE 7) ---
+    # All obs_* instrumentation is host-side only (host clocks, no extra
+    # device syncs) and cheap-on by default: recording an event is one
+    # tuple append into a bounded ring.
+    # flight-recorder ring capacity (events kept in memory; post-mortem
+    # dumps and trace exports cover at most this window). 0 disables the
+    # recorder entirely — spans and lifecycle events become no-ops
+    obs_events: int = 4096
+    # where fault-path post-mortem event dumps land (rolling one file per
+    # fault reason, overwritten on recurrence). "auto" = a postmortem/
+    # subdirectory of the component's output dir (the Trainer's output_dir;
+    # the serve engine uses output_dir directly); "" disables auto-dumps
+    obs_postmortem_dir: str = "auto"
+    # periodic JSONL metrics snapshots (the per-replica scrape surface a
+    # multi-replica router consumes next to the Prometheus exposition);
+    # "" = off. The serve CLI maps --metrics_file here
+    obs_metrics_file: str = ""
+    # snapshot/heartbeat cadence for obs_metrics_file, seconds
+    obs_metrics_every_s: float = 10.0
+    # per-iteration scalar-log cadence for the training loop (scalars.jsonl
+    # `it` records, mirroring the reference's every-50-iters TensorBoard
+    # loss): log every N iterations; 0 disables the per-iteration records
+    # (epoch records still stream). Replaces the hard-coded `it % 50`
+    scalar_log_every: int = 50
     # --- resilience (csat_tpu/resilience/) ---
     # in-step non-finite guard: detect NaN/Inf loss or grad-norm inside the
     # jitted step and skip the optimizer update via lax.cond (donation
@@ -393,6 +417,9 @@ class Config:
         assert self.serve_max_retries >= 0, self.serve_max_retries
         assert self.serve_reap_margin >= 1, self.serve_reap_margin
         assert self.snapshot_every_steps >= 0, self.snapshot_every_steps
+        assert self.obs_events >= 0, self.obs_events
+        assert self.obs_metrics_every_s > 0, self.obs_metrics_every_s
+        assert self.scalar_log_every >= 0, self.scalar_log_every
         assert self.bucket_token_budget >= 0, self.bucket_token_budget
         assert all(n >= 1 for n in self.bucket_src_lens), self.bucket_src_lens
         assert all(t >= 2 for t in self.bucket_tgt_lens), (

@@ -1,0 +1,36 @@
+"""Unified telemetry (ISSUE 7): metrics registry, event flight recorder,
+and Chrome-trace export shared by serving, training and the resilience
+layer.
+
+* ``obs/metrics.py`` — typed counter/gauge/histogram registry with
+  Prometheus text exposition and periodic JSONL snapshots; backs
+  ``ServeStats`` and the Trainer's counters while keeping their existing
+  ``summary()``/dict contracts.
+* ``obs/events.py`` — bounded ring-buffer flight recorder of structured
+  events (request lifecycles, engine tick phases, train-step phases,
+  resilience actions), auto-dumped to rolling post-mortem JSONL files
+  whenever a fault path fires.
+* ``obs/trace.py`` — exports recorder spans as Chrome/Perfetto
+  trace-event JSON and brackets them with ``jax.profiler.TraceAnnotation``
+  so host phases line up with device traces from ``--profile``.
+
+All instrumentation is host-side (host clocks only, no extra device
+syncs) and gated by the ``obs_*`` config family — cheap-on by default.
+``tools/obs_report.py`` renders a one-screen run report from the emitted
+metrics/events files.
+"""
+
+from csat_tpu.obs.events import EventRecorder, Span  # noqa: F401
+from csat_tpu.obs.metrics import (  # noqa: F401
+    Counter,
+    Gauge,
+    Histogram,
+    MetricsFile,
+    MetricsRegistry,
+)
+from csat_tpu.obs.trace import (  # noqa: F401
+    load_chrome_trace,
+    to_chrome_events,
+    validate_chrome_trace,
+    write_chrome_trace,
+)

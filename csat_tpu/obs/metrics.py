@@ -1,0 +1,218 @@
+"""Typed metrics registry: counters, gauges, histograms.
+
+One process-local registry per component (the serve engine owns one through
+:class:`~csat_tpu.serve.stats.ServeStats`, the Trainer owns one directly).
+Two export surfaces, both machine-readable:
+
+* :meth:`MetricsRegistry.prometheus` — Prometheus text exposition
+  (``# HELP`` / ``# TYPE`` / samples; histograms expose cumulative
+  ``_bucket{le=...}`` series plus ``_sum``/``_count``) — what a
+  multi-replica router scrapes per replica;
+* :meth:`MetricsRegistry.snapshot` + :class:`MetricsFile` — flat JSONL
+  snapshots appended at a bounded cadence, the file format
+  ``tools/obs_report.py`` and the serve CLI's ``--metrics_file`` consume.
+
+Everything here is host-side plain Python — no jax import, no device
+traffic; a metric update is one attribute store, so the hot paths
+(engine tick, train step) can update unconditionally.
+"""
+
+from __future__ import annotations
+
+import bisect
+import json
+import os
+import re
+import time
+from typing import Callable, Dict, List, Optional, Sequence, Tuple, Union
+
+__all__ = [
+    "Counter", "Gauge", "Histogram", "MetricsRegistry", "MetricsFile",
+    "DEFAULT_BUCKETS",
+]
+
+_NAME_RE = re.compile(r"^[a-zA-Z_:][a-zA-Z0-9_:]*$")
+
+# latency-oriented default buckets (seconds), roughly log-spaced
+DEFAULT_BUCKETS: Tuple[float, ...] = (
+    0.001, 0.005, 0.01, 0.05, 0.1, 0.5, 1.0, 5.0, 10.0, 30.0, 60.0)
+
+
+def _fmt(v: float) -> str:
+    """Prometheus sample formatting: integers without a trailing ``.0`` so
+    counters read naturally; floats via repr (shortest round-trip)."""
+    f = float(v)
+    if f == int(f) and abs(f) < 1e15:
+        return str(int(f))
+    return repr(f)
+
+
+class Counter:
+    """Monotonic by convention; ``value`` is directly assignable because the
+    pre-existing stats surfaces (``ServeStats``) expose writable attributes
+    (the bench advances ``decode_steps`` to skip idle trace gaps)."""
+
+    kind = "counter"
+    __slots__ = ("name", "help", "value")
+
+    def __init__(self, name: str, help: str = ""):
+        self.name = name
+        self.help = help
+        self.value: Union[int, float] = 0
+
+    def inc(self, n: Union[int, float] = 1) -> None:
+        self.value += n
+
+    def samples(self) -> List[Tuple[str, Union[int, float]]]:
+        return [(self.name, self.value)]
+
+
+class Gauge:
+    kind = "gauge"
+    __slots__ = ("name", "help", "value")
+
+    def __init__(self, name: str, help: str = ""):
+        self.name = name
+        self.help = help
+        self.value: Union[int, float] = 0
+
+    def set(self, v: Union[int, float]) -> None:
+        self.value = v
+
+    def inc(self, n: Union[int, float] = 1) -> None:
+        self.value += n
+
+    def samples(self) -> List[Tuple[str, Union[int, float]]]:
+        return [(self.name, self.value)]
+
+
+class Histogram:
+    """Fixed-bucket histogram with Prometheus cumulative-``le`` exposition.
+
+    ``observe`` is two int adds and a bisect — cheap enough for per-request
+    latency recording on the serving path."""
+
+    kind = "histogram"
+    __slots__ = ("name", "help", "buckets", "counts", "sum", "count")
+
+    def __init__(self, name: str, help: str = "",
+                 buckets: Sequence[float] = DEFAULT_BUCKETS):
+        self.name = name
+        self.help = help
+        self.buckets: Tuple[float, ...] = tuple(sorted(float(b) for b in buckets))
+        assert self.buckets, "histogram needs at least one finite bucket"
+        # per-bucket NON-cumulative counts; the +Inf overflow is the last slot
+        self.counts = [0] * (len(self.buckets) + 1)
+        self.sum = 0.0
+        self.count = 0
+
+    def observe(self, v: float) -> None:
+        self.counts[bisect.bisect_left(self.buckets, v)] += 1
+        self.sum += v
+        self.count += 1
+
+    def samples(self) -> List[Tuple[str, Union[int, float]]]:
+        out: List[Tuple[str, Union[int, float]]] = []
+        cum = 0
+        for le, c in zip(self.buckets, self.counts):
+            cum += c
+            out.append((f'{self.name}_bucket{{le="{_fmt(le)}"}}', cum))
+        out.append((f'{self.name}_bucket{{le="+Inf"}}', self.count))
+        out.append((f"{self.name}_sum", self.sum))
+        out.append((f"{self.name}_count", self.count))
+        return out
+
+
+class MetricsRegistry:
+    """Get-or-create registry keyed by metric name (registration order is
+    exposition order, so output is deterministic)."""
+
+    def __init__(self) -> None:
+        self._metrics: Dict[str, Union[Counter, Gauge, Histogram]] = {}
+
+    def _get(self, cls, name: str, help: str, **kw):
+        assert _NAME_RE.match(name), f"invalid metric name {name!r}"
+        m = self._metrics.get(name)
+        if m is None:
+            m = cls(name, help, **kw)
+            self._metrics[name] = m
+        elif not isinstance(m, cls):
+            raise TypeError(
+                f"metric {name!r} already registered as {m.kind}, "
+                f"requested {cls.kind}")
+        return m
+
+    def counter(self, name: str, help: str = "") -> Counter:
+        return self._get(Counter, name, help)
+
+    def gauge(self, name: str, help: str = "") -> Gauge:
+        return self._get(Gauge, name, help)
+
+    def histogram(self, name: str, help: str = "",
+                  buckets: Sequence[float] = DEFAULT_BUCKETS) -> Histogram:
+        return self._get(Histogram, name, help, buckets=buckets)
+
+    def __iter__(self):
+        return iter(self._metrics.values())
+
+    def prometheus(self) -> str:
+        """Prometheus text exposition (version 0.0.4)."""
+        lines: List[str] = []
+        for m in self._metrics.values():
+            if m.help:
+                lines.append(f"# HELP {m.name} {m.help}")
+            lines.append(f"# TYPE {m.name} {m.kind}")
+            for sample, value in m.samples():
+                lines.append(f"{sample} {_fmt(value)}")
+        return "\n".join(lines) + "\n"
+
+    def snapshot(self) -> Dict[str, float]:
+        """Flat name→value dict (histograms contribute ``_sum``/``_count``
+        only — buckets stay a Prometheus concern) for JSONL streaming."""
+        out: Dict[str, float] = {}
+        for m in self._metrics.values():
+            if isinstance(m, Histogram):
+                out[f"{m.name}_sum"] = round(m.sum, 6)
+                out[f"{m.name}_count"] = m.count
+            else:
+                v = m.value
+                out[m.name] = round(v, 6) if isinstance(v, float) else v
+        return out
+
+
+class MetricsFile:
+    """Periodic JSONL snapshot appender.
+
+    ``maybe_write`` is called opportunistically from a serving/training loop
+    and only touches the filesystem once per ``every_s`` window (or when
+    forced — shutdown writes the final state unconditionally).  The
+    registry is looked up through a callable so a caller whose registry is
+    replaced mid-run (``ServeEngine.reset_stats`` builds a fresh
+    ``ServeStats``) always snapshots the live one."""
+
+    def __init__(self, path: str,
+                 registry: Union[MetricsRegistry, Callable[[], MetricsRegistry]],
+                 every_s: float = 10.0,
+                 clock: Callable[[], float] = time.monotonic):
+        self.path = path
+        self._registry = registry if callable(registry) else (lambda: registry)
+        self.every_s = float(every_s)
+        self._clock = clock
+        self._last = -float("inf")
+        self.written = 0
+
+    def maybe_write(self, extra: Optional[Dict] = None, force: bool = False) -> bool:
+        now = self._clock()
+        if not force and now - self._last < self.every_s:
+            return False
+        self._last = now
+        rec = {"t": round(time.time(), 3), **self._registry().snapshot()}
+        if extra:
+            rec.update(extra)
+        d = os.path.dirname(self.path)
+        if d:
+            os.makedirs(d, exist_ok=True)
+        with open(self.path, "a") as f:
+            f.write(json.dumps(rec) + "\n")
+        self.written += 1
+        return True

@@ -100,14 +100,25 @@ class FaultInjector:
         self.serve_wedge_slots = {int(t): int(s) for t, s in serve_wedge_slots}
         self.serve_decode_fail_ticks = frozenset(
             int(t) for t in serve_decode_fail_ticks)
+        # optional flight recorder (csat_tpu/obs/events.py): the component
+        # consuming the injector attaches its own recorder so every fired
+        # fault is stamped into the SAME timeline the post-mortem dumps —
+        # a drill's dump shows cause (fault.injected.*) next to effect
+        self.recorder = None
+
+    def _note(self, kind: str, **fields) -> None:
+        if self.recorder is not None:
+            self.recorder.emit(f"fault.injected.{kind}", **fields)
 
     # -- train-step faults -------------------------------------------------
 
     def loss_scale(self, step: int) -> Optional[float]:
         """Loss multiplier for global step ``step`` (None = no fault)."""
         if step in self.nan_loss_steps:
+            self._note("nan_loss", step=step)
             return math.nan
         if step in self.spike_steps:
+            self._note("spike", step=step)
             return self.spike_scale
         return None
 
@@ -115,6 +126,7 @@ class FaultInjector:
         """Stall the loop between heartbeats, simulating a hung device
         step from the watchdog's point of view."""
         if self.hang_at_step is not None and step == self.hang_at_step:
+            self._note("hang", step=step, seconds=self.hang_seconds)
             self._sleep(self.hang_seconds)
 
     def fire_preemption(self, step: int, handler) -> bool:
@@ -123,6 +135,7 @@ class FaultInjector:
         installed), else directly on the handler's flag."""
         if self.preempt_at_step is None or step != self.preempt_at_step:
             return False
+        self._note("preemption", step=step)
         if self.deliver_signal:
             os.kill(os.getpid(), signal.SIGTERM)
         else:
@@ -136,23 +149,31 @@ class FaultInjector:
         tick's decode (None = no fault). The poison only reaches the
         logits once the row attends to a poisoned cached position, i.e.
         on rows with ``pos >= 1`` — inject after the row's first step."""
-        return self.serve_nan_logits.get(tick)
+        slot = self.serve_nan_logits.get(tick)
+        if slot is not None:
+            self._note("nan_logits", tick=tick, slot=slot)
+        return slot
 
     def wedge_slot(self, tick: int) -> Optional[int]:
         """Slot whose device row should be silently frozen at this tick
         (the host scheduler is NOT told — the row just stops retiring)."""
-        return self.serve_wedge_slots.get(tick)
+        slot = self.serve_wedge_slots.get(tick)
+        if slot is not None:
+            self._note("wedge_slot", tick=tick, slot=slot)
+        return slot
 
     def maybe_hang_tick(self, tick: int) -> None:
         """Host stall inside the scheduler tick — the wedged-dispatch mode
         the serve watchdog turns into a bounded outage."""
         if self.serve_hang_at_tick is not None and tick == self.serve_hang_at_tick:
+            self._note("hang_tick", tick=tick, seconds=self.hang_seconds)
             self._sleep(self.hang_seconds)
 
     def maybe_fail_prefill(self, call_ordinal: int) -> None:
         """Raise on the configured prefill call ordinals — a device fault
         inside the admission program."""
         if call_ordinal in self.serve_prefill_fail_calls:
+            self._note("prefill_fail", call=call_ordinal)
             raise RuntimeError(
                 f"injected prefill failure at call {call_ordinal}")
 
@@ -160,6 +181,7 @@ class FaultInjector:
         """Raise on the configured decode ticks — a device fault escaping
         the decode dispatch, exercising rebuild-and-resubmit."""
         if tick in self.serve_decode_fail_ticks:
+            self._note("decode_fail", tick=tick)
             raise RuntimeError(f"injected decode fault at tick {tick}")
 
     @staticmethod
@@ -193,6 +215,7 @@ class FaultInjector:
         ordinal = self._batch_ordinal
         self._batch_ordinal += 1
         if ordinal in self.corrupt_batches:
+            self._note("corrupt_batch", batch=ordinal)
             raise CorruptBatchError(
                 f"injected corrupt batch at ordinal {ordinal} "
                 f"(samples {list(map(int, chunk_indices))})")

@@ -88,10 +88,17 @@ class StepWatchdog:
         log: Callable[[str], None] = lambda m: print(m, file=sys.stderr),
         probe: Optional[Callable[[], None]] = None,
         probe_interval_s: Optional[float] = None,
+        on_trip: Optional[Callable[[str, float], None]] = None,
     ) -> None:
         assert timeout_s > 0, timeout_s
         self.timeout_s = float(timeout_s)
         self._on_timeout = on_timeout or _default_abort
+        # observability hook (csat_tpu/obs): called with (what, stalled_s)
+        # BEFORE diagnostics/abort so the trip lands in the flight recorder
+        # and triggers a post-mortem dump while the process still exists.
+        # Runs on the monitor thread; exceptions are swallowed — telemetry
+        # must never mask the abort itself
+        self._on_trip = on_trip
         self._diag_path = diag_path
         self._log = log
         self._lock = threading.Lock()
@@ -193,6 +200,11 @@ class StepWatchdog:
 
     def _trip(self, stalled_s: float, what: str = "no completed step") -> None:
         self._tripped.set()
+        if self._on_trip is not None:
+            try:
+                self._on_trip(what, stalled_s)
+            except Exception:  # noqa: BLE001 — see __init__
+                pass
         self._log(
             f"# watchdog: {what} for {stalled_s:.1f}s "
             f"(timeout {self.timeout_s:.1f}s) — dumping diagnostics and "

@@ -76,6 +76,24 @@ def _parser() -> argparse.ArgumentParser:
     p.add_argument("--drain_deadline_s", type=float, default=30.0,
                    help="serve: on SIGTERM/SIGINT, drain in-flight work "
                         "for at most this long before shedding the rest")
+    p.add_argument("--metrics_file", default="",
+                   help="append periodic JSONL metrics snapshots here "
+                        "(csat_tpu/obs/metrics.py format — the per-replica "
+                        "scrape surface; cadence --metrics_every_s)")
+    p.add_argument("--metrics_every_s", type=float, default=0.0,
+                   help="metrics-snapshot cadence in seconds (default: "
+                        "config obs_metrics_every_s)")
+    p.add_argument("--heartbeat_s", type=float, default=0.0,
+                   help="serve: print a one-line JSON heartbeat (key "
+                        "counters + queue state) to stderr every N seconds "
+                        "(0 = off)")
+    p.add_argument("--trace_file", default="",
+                   help="on exit, export the engine's recorded phase spans "
+                        "as Chrome trace-event JSON here (load in "
+                        "chrome://tracing or ui.perfetto.dev)")
+    p.add_argument("--postmortem_dir", default="",
+                   help="where fault post-mortem event dumps land (default: "
+                        "config obs_postmortem_dir)")
     p.add_argument("--platform", default="", help="force jax platform (cpu/tpu)")
     p.add_argument("--sep", default="\x00",
                    help="summarize stdin snippet separator (default NUL)")
@@ -120,6 +138,12 @@ def build_engine(args):
         overrides["serve_num_pages"] = args.num_pages
     if getattr(args, "prefix_cache", -1) >= 0:
         overrides["serve_prefix_cache"] = args.prefix_cache
+    if getattr(args, "metrics_file", ""):
+        overrides["obs_metrics_file"] = args.metrics_file
+    if getattr(args, "metrics_every_s", 0.0) > 0:
+        overrides["obs_metrics_every_s"] = args.metrics_every_s
+    if getattr(args, "postmortem_dir", ""):
+        overrides["obs_postmortem_dir"] = args.postmortem_dir
     cfg = get_config(args.config, **overrides)
 
     src_vocab, tgt_vocab = load_vocab(cfg.data_dir)
@@ -138,6 +162,32 @@ def build_engine(args):
     return engine, cfg, src_vocab, trip_vocab
 
 
+def _telemetry(engine, cfg, args):
+    """Shared telemetry sinks for both subcommands: an optional periodic
+    JSONL metrics writer and a finalizer that flushes the last snapshot
+    and exports the engine's phase-span timeline as a Chrome trace."""
+    from csat_tpu.obs import MetricsFile, write_chrome_trace
+
+    writer = None
+    if cfg.obs_metrics_file:
+        # registry looked up per write: reset_stats swaps the stats object
+        writer = MetricsFile(cfg.obs_metrics_file,
+                             lambda: engine.stats.registry,
+                             every_s=cfg.obs_metrics_every_s)
+
+    def extra():
+        return {"queue_depth": engine.queue_depth,
+                "occupancy": engine.occupancy}
+
+    def finalize() -> None:
+        if writer is not None:
+            writer.maybe_write(extra=extra(), force=True)
+        if getattr(args, "trace_file", ""):
+            write_chrome_trace(args.trace_file, engine.obs)
+
+    return writer, extra, finalize
+
+
 def _ingest(engine, cfg, src_vocab, trip_vocab, code: str,
             max_new_tokens: int) -> Optional[int]:
     from csat_tpu.serve.ingest import sample_from_source
@@ -148,6 +198,7 @@ def _ingest(engine, cfg, src_vocab, trip_vocab, code: str,
 
 def _summarize(args) -> None:
     engine, cfg, src_vocab, trip_vocab = build_engine(args)
+    _, _, finalize = _telemetry(engine, cfg, args)
     if args.files:
         snippets = [open(f, encoding="utf-8").read() for f in args.files]
         names: List[str] = list(args.files)
@@ -189,6 +240,7 @@ def _summarize(args) -> None:
             "summary": " ".join(engine.words(req)),
             "n_tokens": req.n_tokens,
         }))
+    finalize()
     import jax
 
     print(json.dumps(engine.stats.summary(n_chips=jax.device_count())),
@@ -278,6 +330,16 @@ def _serve(args) -> None:
     from csat_tpu.resilience.retry import DataErrorBudgetExceeded
 
     engine, cfg, src_vocab, trip_vocab = build_engine(args)
+    writer, extra, finalize = _telemetry(engine, cfg, args)
+    import jax
+
+    n_chips = jax.device_count()
+    hb_every = max(args.heartbeat_s, 0.0)
+    last_hb = engine.clock()
+    # the heartbeat line is a compact stderr pulse a human (or a log
+    # scraper) can follow without parsing the metrics file
+    hb_keys = ("submitted", "retired", "failed", "timeouts", "rejected",
+               "shed", "gen_tokens", "gen_tokens_per_sec", "compiles")
 
     def flush_finished(pending: dict) -> None:
         # pop_result keeps the engine's results map bounded over a long run
@@ -347,10 +409,18 @@ def _serve(args) -> None:
             if engine.occupancy or engine.queue_depth:
                 engine.tick()
             flush_finished(pending)
+            if writer is not None:
+                writer.maybe_write(extra=extra())
+            if hb_every and engine.clock() - last_hb >= hb_every:
+                last_hb = engine.clock()
+                s = engine.stats.summary(n_chips=n_chips)
+                hb = {k: s[k] for k in hb_keys}
+                hb.update(queue_depth=engine.queue_depth,
+                          occupancy=engine.occupancy)
+                print(f"# heartbeat {json.dumps(hb)}", file=sys.stderr)
     engine.close()
-    import jax
-
-    print(json.dumps(engine.stats.summary(n_chips=jax.device_count())),
+    finalize()
+    print(json.dumps(engine.stats.summary(n_chips=n_chips)),
           file=sys.stderr)
 
 

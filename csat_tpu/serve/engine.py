@@ -54,9 +54,10 @@ small ``(S, 3)`` status fetch plus the queue bookkeeping.
 from __future__ import annotations
 
 import dataclasses
+import os
 import time
 from collections import defaultdict, deque
-from typing import Any, Callable, Deque, Dict, List, Optional, Sequence
+from typing import Any, Callable, Deque, Dict, List, Optional, Sequence, Set
 
 import jax
 import jax.numpy as jnp
@@ -65,6 +66,7 @@ import numpy as np
 from csat_tpu.configs import Config
 from csat_tpu.data.vocab import Vocab
 from csat_tpu.models import CSATrans
+from csat_tpu.obs import EventRecorder
 from csat_tpu.resilience.retry import ErrorBudget
 from csat_tpu.resilience.watchdog import StepWatchdog
 from csat_tpu.serve.ingest import PoisonRequestError, validate_sample
@@ -184,7 +186,24 @@ class ServeEngine:
         self.specs = prefill_plan(cfg)
         self.stats = ServeStats(self.num_slots)
         self.stats.started_t = clock()
-        # deterministic fault drills (resilience/faults.py serve hooks)
+        # flight recorder (csat_tpu/obs, ISSUE 7): request lifecycles, tick
+        # phases and resilience actions as structured events in a bounded
+        # ring; any fault path schedules a post-mortem dump of the ring so
+        # an incident leaves a timeline. All host-side — no device syncs.
+        self.obs = EventRecorder(capacity=cfg.obs_events, component="serve")
+        pm = cfg.obs_postmortem_dir
+        self._postmortem_dir = (
+            os.path.join(cfg.output_dir, "postmortem") if pm == "auto" else pm)
+        # fault reasons whose dump is pending: coalesced per tick/submit
+        # AND rate-limited per reason (_flush_postmortems) so a shed/
+        # timeout storm rewrites one rolling file per reason per interval,
+        # not one file per request
+        self._pending_dumps: Set[str] = set()
+        self._last_dump_t: Dict[str, float] = {}
+        # deterministic fault drills (resilience/faults.py serve hooks);
+        # the injector stamps its fired faults into the same timeline
+        # (property setter below attaches the recorder, so drills that
+        # assign an injector mid-run are covered too)
         self.fault_injector = fault_injector
 
         # KV layout: block-paged pool (serve/pages.py) or the PR-3 per-slot
@@ -302,6 +321,7 @@ class ServeEngine:
             self._watchdog = StepWatchdog(
                 cfg.serve_watchdog_timeout_s,
                 on_timeout=watchdog_on_timeout,
+                on_trip=self._watchdog_trip,
                 log=log).start()
 
     def close(self) -> None:
@@ -309,6 +329,54 @@ class ServeEngine:
         if self._watchdog is not None:
             self._watchdog.stop()
             self._watchdog = None
+        self._flush_postmortems(force=True)
+
+    # ---------------- observability plumbing ----------------
+
+    @property
+    def fault_injector(self):
+        return self._fault_injector
+
+    @fault_injector.setter
+    def fault_injector(self, inj) -> None:
+        self._fault_injector = inj
+        if inj is not None and getattr(inj, "recorder", None) is None:
+            inj.recorder = self.obs
+
+    def _note_fault(self, reason: str) -> None:
+        """Schedule a post-mortem dump for this fault class (coalesced —
+        flushed at the end of the current tick/submit)."""
+        if self._postmortem_dir and self.obs.enabled:
+            self._pending_dumps.add(reason)
+
+    # floor between same-reason dump rewrites: a reject/shed storm pays one
+    # full-ring write per reason per interval, not one per request (the
+    # pending reason is retried on later flushes, so the dump still lands)
+    _POSTMORTEM_MIN_INTERVAL_S = 1.0
+
+    def _flush_postmortems(self, force: bool = False) -> None:
+        """Write pending fault dumps. ``force`` (drain end, shed_all,
+        close) ignores the rate limit so a quiescent engine always leaves
+        the newest timeline on disk; the non-forced tick/submit path keeps
+        a reason pending until its interval elapses."""
+        if not self._pending_dumps:
+            return
+        now = time.monotonic()
+        for reason in list(self._pending_dumps):
+            if not force and (now - self._last_dump_t.get(reason, -1e9)
+                              < self._POSTMORTEM_MIN_INTERVAL_S):
+                continue
+            self._pending_dumps.discard(reason)
+            self._last_dump_t[reason] = now
+            self.obs.postmortem(self._postmortem_dir, reason)
+
+    def _watchdog_trip(self, what: str, stalled_s: float) -> None:
+        """StepWatchdog on_trip hook — runs on the MONITOR thread while the
+        scheduler is wedged, so the dump happens here, not at tick end."""
+        self.obs.emit("fault.watchdog", what=what,
+                      stalled_s=round(stalled_s, 3))
+        if self._postmortem_dir:
+            self.obs.postmortem(self._postmortem_dir, "watchdog")
 
     # ---------------- public API ----------------
 
@@ -339,6 +407,7 @@ class ServeEngine:
             deadline_t=(now + ddl) if ddl and ddl > 0 else None)
         self._next_id += 1
         self.stats.submitted += 1
+        self.obs.emit("req.submit", id=req.id, limit=limit)
         if req.deadline_t is not None:
             self._has_deadlines = True
 
@@ -349,8 +418,10 @@ class ServeEngine:
             # raises DataErrorBudgetExceeded once the budget is spent
             self._poison_budget([req.id], e)
             self.stats.quarantined = self._poison_budget.count
+            self.obs.emit("fault.poison", id=req.id, error=str(e))
             self._finish(req, RequestStatus.FAILED,
                          error=f"poison request: {e}", now=now)
+            self._flush_postmortems()
             return req.id
         if self._prefix is not None:
             req.phash = sample_hash(sample)
@@ -361,11 +432,13 @@ class ServeEngine:
             if self.cfg.serve_queue_policy == "reject":
                 self._finish(req, RequestStatus.REJECTED,
                              error=f"queue full ({max_q})", now=now)
+                self._flush_postmortems()
                 return req.id
             shed = self._queue.popleft()  # shed_oldest: freshest work wins
             self._finish(shed, RequestStatus.SHED,
                          error=f"shed by admission control (queue {max_q})",
                          now=now)
+            self._flush_postmortems()
         self._queue.append(req)
         return req.id
 
@@ -406,10 +479,15 @@ class ServeEngine:
                 self._watchdog.beat()
             else:
                 self._watchdog.disarm()  # idle is not a hang
+        # rate-limited while busy (a fault storm rewrites each reason's
+        # rolling file once per interval); an idle engine flushes whatever
+        # is pending so the newest timeline is always on disk at quiescence
+        self._flush_postmortems(force=not (live or self._queue))
         return live
 
     def _tick_body(self, tick: int) -> int:
         inj = self.fault_injector
+        obs = self.obs
         if inj is not None:
             inj.maybe_hang_tick(tick)
             wedge = inj.wedge_slot(tick)
@@ -417,12 +495,18 @@ class ServeEngine:
                 # silently freeze the device row — the host scheduler is
                 # NOT told, so only the reaper can recover the request
                 self._freeze_rows([wedge])
+        t0 = time.perf_counter()
         self._retire()
         self._expire_and_reap()
+        obs.span_from("tick.retire", t0)
+        t0 = time.perf_counter()
         self._admit()
+        obs.span_from("tick.admit", t0)
         if self.paged:
             self.stats.note_pages(self._allocator.used_pages)
+        self.stats.queue_depth = len(self._queue)
         live = sum(r is not None for r in self._slots)
+        self.stats.occupancy = live
         if live:
             try:
                 if inj is not None:
@@ -430,8 +514,16 @@ class ServeEngine:
                     if slot is not None:
                         self._inject_nan(slot)
                     inj.maybe_fail_decode(tick)
+                # decode dispatch returns as soon as the program is queued;
+                # the status fetch below is where the host actually waits
+                # on the device — the two spans split host share from
+                # device share without adding any sync
+                t0 = time.perf_counter()
                 self._pool, status = self._decode_prog(self._pool)
+                obs.span_from("tick.decode_dispatch", t0, live=live)
+                t0 = time.perf_counter()
                 self._status = np.asarray(status)
+                obs.span_from("tick.status_fetch", t0)
                 self.stats.decode_steps += 1
             except Exception as e:  # noqa: BLE001 — device fault: self-heal
                 self._rebuild_and_resubmit(e)
@@ -459,6 +551,7 @@ class ServeEngine:
         self._retire()  # collect rows finished by the final decode step
         if self._watchdog is not None:
             self._watchdog.disarm()
+        self._flush_postmortems(force=True)
         return self._results
 
     def shed_all(self, reason: str = "graceful drain deadline") -> int:
@@ -481,6 +574,7 @@ class ServeEngine:
         self._release_rows(freeze)
         if self._watchdog is not None:
             self._watchdog.disarm()
+        self._flush_postmortems(force=True)
         return n
 
     def words(self, req: Request) -> List[str]:
@@ -504,7 +598,7 @@ class ServeEngine:
         programs first, then measure a clean window."""
         old = self.stats
         self.stats = ServeStats(self.num_slots)
-        self.stats.compile_events = list(old.compile_events)
+        self.stats.carry_compiles(old)
         self.stats.started_t = self.clock()
         self._sync_page_stats()
         return self.stats
@@ -531,8 +625,14 @@ class ServeEngine:
         req.sample = None  # release the (N, N) payload
         if status == RequestStatus.OK:
             self.stats.record_request(req.submit_t, req.admit_t, now, req.n_tokens)
+            self.obs.emit("req.ok", id=req.id, n_tokens=req.n_tokens)
         else:
             self.stats.record_outcome(status)
+            # terminal lifecycle event FIRST, then the post-mortem note —
+            # the dump that follows includes this transition in its timeline
+            self.obs.emit("req." + status.lower(), id=req.id,
+                          n_tokens=req.n_tokens, error=error)
+            self._note_fault(status)
             if error:
                 self.log(f"# serve: request {req.id} {status}: {error}")
         self._results[req.id] = req
@@ -710,6 +810,8 @@ class ServeEngine:
         if bad_rows:
             self._release_rows(bad_rows)
             for i in bad_rows:
+                self.obs.emit("fault.nan_guard", slot=i,
+                              id=self._slots[i].id)
                 self._finish_slot(
                     i, RequestStatus.FAILED,
                     error="non-finite logits during decode", now=now,
@@ -766,6 +868,8 @@ class ServeEngine:
                     > req.limit + self.cfg.serve_reap_margin):
                 freeze.append(i)
                 self.stats.reaped += 1
+                self.obs.emit("fault.reap", id=req.id, slot=i,
+                              ticks=self._tick_no - req.admit_tick)
                 self._finish_slot(
                     i, RequestStatus.FAILED,
                     error=f"stuck slot reaped after "
@@ -885,12 +989,16 @@ class ServeEngine:
                     params, batch, ids, limits,
                     jax.random.fold_in(self._base_key, ordinal), pool),
                 donate_argnums=(5,))
+            t0 = time.perf_counter()
             prog = fn.lower(self._dparams, batch, ids, limits, ordinal,
                             self._pool).compile()
+            self.obs.span_from("compile.prefill", t0, n=spec.n)
             self._prefill_progs[k] = prog
             self.stats.record_compile("prefill", (spec.n, spec.batch_size))
+        t0 = time.perf_counter()
         self._pool = prog(self._dparams, batch, ids, limits, ordinal,
                           self._pool)
+        self.obs.span_from(f"prefill.n{spec.n}", t0, rows=len(chunk))
         self.stats.prefill_calls += 1
         self._mark_admitted(chunk, slot_ids, plans)
 
@@ -941,12 +1049,16 @@ class ServeEngine:
                         cross_chain,
                         jax.random.fold_in(self._base_key, ordinal), pool),
                     donate_argnums=(7,))
+                t0 = time.perf_counter()
                 prog = fn.lower(self._dparams, batch, ids, limits, self_rows,
                                 cross_chain, ordinal, self._pool).compile()
+                self.obs.span_from("compile.prefill", t0, n=spec.n)
                 self._prefill_progs[k] = prog
                 self.stats.record_compile("prefill", (spec.n, spec.batch_size))
+            t0 = time.perf_counter()
             self._pool = prog(self._dparams, batch, ids, limits, self_rows,
                               cross_chain, ordinal, self._pool)
+            self.obs.span_from(f"prefill.n{spec.n}", t0, rows=len(misses))
             self.stats.prefill_calls += 1
             if self._prefix is not None:
                 # publish the fresh chains — ownership moves to the cache
@@ -982,8 +1094,10 @@ class ServeEngine:
                 sm = np.asarray(req.sample["src_seq"]) == PAD
                 sm[spec.n:] = True
                 smask[j] = sm
+            t0 = time.perf_counter()
             self._pool = self._attach_prog(
                 self._pool, ids, limits, self_rows, cross_rows, smask)
+            self.obs.span_from("prefill.attach", t0, rows=len(hits))
         self._mark_admitted(chunk, slot_ids, plans)
 
     def _mark_admitted(self, chunk: List[Request], slot_ids: List[int],
@@ -996,6 +1110,8 @@ class ServeEngine:
             req.admit_tick = self._tick_no
             self._slots[s] = req
             self._slot_meta[s] = plans[j] if plans else None
+            self.obs.emit("req.admit", id=req.id, slot=s, bucket=req.bucket,
+                          hit=bool(plans and plans[j].hit))
 
     def _rebuild_and_resubmit(self, exc: BaseException) -> None:
         """Self-healing after a device fault escaped the decode dispatch:
@@ -1008,6 +1124,12 @@ class ServeEngine:
         resolves FAILED, and an engine past ``serve_max_rebuilds``
         re-raises (the process itself needs restarting)."""
         if self._rebuilds >= self.cfg.serve_max_rebuilds:
+            # the fault propagates out of tick() — dump NOW, the caller's
+            # error handling may be the end of this process
+            self.obs.emit("fault.rebuild_cap", rebuilds=self._rebuilds,
+                          error=f"{type(exc).__name__}: {exc}")
+            if self._postmortem_dir:
+                self.obs.postmortem(self._postmortem_dir, "rebuild_cap")
             raise RuntimeError(
                 f"device fault after {self._rebuilds} rebuilds "
                 f"(serve_max_rebuilds={self.cfg.serve_max_rebuilds}): "
@@ -1015,6 +1137,10 @@ class ServeEngine:
         self._rebuilds += 1
         self.stats.rebuilds += 1
         inflight = [r for r in self._slots if r is not None]
+        self.obs.emit("fault.rebuild", rebuild=self._rebuilds,
+                      inflight=len(inflight),
+                      error=f"{type(exc).__name__}: {exc}")
+        self._note_fault("rebuild")
         self.log(f"# serve: device fault ({type(exc).__name__}: {exc}) — "
                  f"rebuild #{self._rebuilds}, resubmitting "
                  f"{len(inflight)} in-flight request(s)")

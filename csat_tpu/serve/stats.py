@@ -7,18 +7,39 @@ and :meth:`ServeStats.record_request` as each request retires.
 :meth:`ServeStats.summary` renders the numbers the ``:serve`` bench mode
 and the CLI report: request-latency percentiles and generated-token
 throughput, per chip and per slot.
+
+Since ISSUE 7 every counter is backed by a
+:class:`~csat_tpu.obs.metrics.MetricsRegistry` metric (the attribute
+surface is unchanged — reads and writes go through descriptors), so the
+same numbers are scrapeable as Prometheus text (:meth:`prometheus`) and
+streamable as JSONL snapshots (``obs/metrics.py:MetricsFile``) — the
+per-replica surface a multi-replica router consumes.  ``compile_events``
+is a BOUNDED window (the newest ``COMPILE_EVENT_WINDOW`` builds) while
+``compiles`` is the authoritative total: a long-running server with
+periodic rebuilds no longer grows the event list forever, and the
+"stops growing at steady state" test contract holds on the counter.
 """
 
 from __future__ import annotations
 
 from collections import deque
-from typing import Deque, Dict, List, Optional, Sequence, Tuple
+from typing import Deque, Dict, Optional, Sequence, Tuple
+
+from csat_tpu.obs.metrics import MetricsRegistry
 
 __all__ = ["ServeStats", "percentile"]
 
 # latency/wait percentile window: bounded so a long-running server's stats
 # stay O(1) in memory (percentiles then describe the most recent window)
 LATENCY_WINDOW = 10_000
+
+# compile-event window: (kind, detail) tuples kept for shape forensics.
+# Steady state builds ZERO programs, so any healthy server fits in this;
+# the total lives in the `compiles` counter either way
+COMPILE_EVENT_WINDOW = 256
+
+# latency buckets for the serving histograms (seconds)
+_LATENCY_BUCKETS = (0.01, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0)
 
 
 def percentile(values: Sequence[float], q: float) -> float:
@@ -31,33 +52,124 @@ def percentile(values: Sequence[float], q: float) -> float:
     return float(xs[k])
 
 
+class _Backed:
+    """Attribute descriptor delegating to a registry metric's value, so the
+    pre-existing ``stats.submitted += 1`` / ``stats.decode_steps = n``
+    call sites double as metric updates with zero API change."""
+
+    __slots__ = ("attr",)
+
+    def __set_name__(self, owner, name: str) -> None:
+        self.attr = name
+
+    def __get__(self, obj, objtype=None):
+        if obj is None:
+            return self
+        return obj._m[self.attr].value
+
+    def __set__(self, obj, value) -> None:
+        obj._m[self.attr].value = value
+
+
+# attribute → (metric kind, prometheus name, help)
+_METRICS = {
+    "submitted": ("counter", "serve_requests_submitted_total",
+                  "requests accepted by submit()"),
+    "admitted": ("counter", "serve_requests_admitted_total",
+                 "requests admitted to a decode slot"),
+    "retired": ("counter", "serve_requests_ok_total",
+                "OK retirements (tokens delivered)"),
+    "rejected": ("counter", "serve_requests_rejected_total",
+                 "queue-full rejections (policy reject)"),
+    "shed": ("counter", "serve_requests_shed_total",
+             "queue-full shed_oldest / graceful-drain sheds"),
+    "timeouts": ("counter", "serve_requests_timeout_total",
+                 "per-request deadline expiries"),
+    "failed": ("counter", "serve_requests_failed_total",
+               "FAILED outcomes (NaN logits, stuck slot, device fault, poison)"),
+    "quarantined": ("counter", "serve_requests_quarantined_total",
+                    "poison submits (subset of failed)"),
+    "reaped": ("counter", "serve_slots_reaped_total",
+               "stuck slots force-retired by the reaper"),
+    "rebuilds": ("counter", "serve_pool_rebuilds_total",
+                 "slot-pool rebuilds after device faults"),
+    "decode_steps": ("counter", "serve_decode_steps_total",
+                     "engine ticks that ran the decode program"),
+    "prefill_calls": ("counter", "serve_prefill_calls_total",
+                      "compiled prefill dispatches"),
+    "gen_tokens": ("counter", "serve_gen_tokens_total",
+                   "real tokens delivered to finished requests"),
+    "compiles": ("counter", "serve_compiled_programs_total",
+                 "compiled-program builds (steady state: zero growth)"),
+    "prefix_hits": ("counter", "serve_prefix_hits_total",
+                    "admissions that skipped prefill via the prefix cache"),
+    "prefix_misses": ("counter", "serve_prefix_misses_total",
+                      "cache-enabled admissions that ran the encoder"),
+    "pages_usable": ("gauge", "serve_kv_pages",
+                     "allocatable KV pages (0 = rectangle layout)"),
+    "rect_pages_per_slot": ("gauge", "serve_rect_pages_per_slot",
+                            "equal-memory yardstick (SP + CP)"),
+    "page_peak": ("gauge", "serve_kv_pages_peak",
+                  "high-water KV pages in use"),
+    "pages_in_use": ("gauge", "serve_kv_pages_in_use",
+                     "KV pages in use at the last tick sample"),
+    "queue_depth": ("gauge", "serve_queue_depth",
+                    "queued (not yet admitted) requests"),
+    "occupancy": ("gauge", "serve_slots_occupied",
+                  "decode slots currently in flight"),
+}
+
+
 class ServeStats:
-    def __init__(self, num_slots: int):
+    # counters / gauges (registry-backed; see _METRICS for exposition names)
+    submitted = _Backed()
+    admitted = _Backed()
+    retired = _Backed()         # OK retirements (tokens delivered)
+    # structured non-OK outcomes (serve/engine.py resilience layer)
+    rejected = _Backed()        # queue-full, policy "reject"
+    shed = _Backed()            # queue-full shed_oldest / graceful-drain shed
+    timeouts = _Backed()        # per-request deadline expiry
+    failed = _Backed()          # NaN logits, stuck slot, prefill/device
+    #                             fault, poison submit — every FAILED outcome
+    quarantined = _Backed()     # poison subset of `failed` (submit-time)
+    reaped = _Backed()          # stuck slots force-retired by the reaper
+    rebuilds = _Backed()        # slot-pool rebuilds after a device fault
+    decode_steps = _Backed()    # engine ticks that ran the decode program
+    prefill_calls = _Backed()
+    gen_tokens = _Backed()      # real tokens delivered to finished requests
+    compiles = _Backed()        # TOTAL compiled-program builds (authoritative;
+    #                             compile_events is a bounded window of it)
+    # block-paged KV pool + prefix cache (serve/pages.py, serve/prefix.py)
+    prefix_hits = _Backed()     # admissions that skipped prefill entirely
+    prefix_misses = _Backed()   # cache-enabled admissions that encoded
+    pages_usable = _Backed()    # allocatable pages (0 = rectangle layout)
+    rect_pages_per_slot = _Backed()  # equal-memory yardstick (SP + CP)
+    page_peak = _Backed()       # high-water pages in use
+    pages_in_use = _Backed()    # last per-tick occupancy sample
+    queue_depth = _Backed()     # scrape-surface mirrors (engine-stamped)
+    occupancy = _Backed()
+
+    def __init__(self, num_slots: int,
+                 registry: Optional[MetricsRegistry] = None):
         self.num_slots = num_slots
-        # (kind, detail) per compiled-program build, in build order —
-        # tests assert this list stops growing after warm-up
-        self.compile_events: List[Tuple[str, Tuple]] = []
-        self.submitted = 0
-        self.admitted = 0
-        self.retired = 0         # OK retirements (tokens delivered)
-        # structured non-OK outcomes (serve/engine.py resilience layer)
-        self.rejected = 0        # queue-full, policy "reject"
-        self.shed = 0            # queue-full shed_oldest / graceful-drain shed
-        self.timeouts = 0        # per-request deadline expiry
-        self.failed = 0          # NaN logits, stuck slot, prefill/device
-                                 # fault, poison submit — every FAILED outcome
-        self.quarantined = 0     # poison subset of `failed` (submit-time)
-        self.reaped = 0          # stuck slots force-retired by the reaper
-        self.rebuilds = 0        # slot-pool rebuilds after a device fault
-        self.decode_steps = 0      # engine ticks that ran the decode program
-        self.prefill_calls = 0
-        self.gen_tokens = 0        # real tokens delivered to finished requests
-        # block-paged KV pool + prefix cache (serve/pages.py, serve/prefix.py)
-        self.prefix_hits = 0       # admissions that skipped prefill entirely
-        self.prefix_misses = 0     # cache-enabled admissions that encoded
-        self.pages_usable = 0      # allocatable pages (0 = rectangle layout)
-        self.rect_pages_per_slot = 0  # equal-memory yardstick (SP + CP)
-        self.page_peak = 0         # high-water pages in use
+        self.registry = registry if registry is not None else MetricsRegistry()
+        self._m = {
+            attr: getattr(self.registry, kind)(name, help)
+            for attr, (kind, name, help) in _METRICS.items()
+        }
+        self.registry.gauge(
+            "serve_slots", "decode-slot pool size").set(num_slots)
+        self.latency_hist = self.registry.histogram(
+            "serve_request_latency_seconds",
+            "submit-to-done latency of OK requests", buckets=_LATENCY_BUCKETS)
+        self.wait_hist = self.registry.histogram(
+            "serve_request_wait_seconds",
+            "submit-to-admit wait of OK requests", buckets=_LATENCY_BUCKETS)
+        # (kind, detail) per compiled-program build, newest-last, BOUNDED —
+        # `compiles` carries the total; tests assert it stops growing after
+        # warm-up
+        self.compile_events: Deque[Tuple[str, Tuple]] = deque(
+            maxlen=COMPILE_EVENT_WINDOW)
         self._page_sum = 0         # Σ per-tick pages in use (mean occupancy)
         self._page_samples = 0
         self.wait_s: Deque[float] = deque(maxlen=LATENCY_WINDOW)     # submit → admit
@@ -70,6 +182,14 @@ class ServeStats:
 
     def record_compile(self, kind: str, detail: Tuple) -> None:
         self.compile_events.append((kind, tuple(detail)))
+        self.compiles += 1
+
+    def carry_compiles(self, old: "ServeStats") -> None:
+        """Inherit the compile history across a stats reset (the programs
+        themselves survive, so the tripwire total must too)."""
+        self.compile_events = deque(
+            old.compile_events, maxlen=COMPILE_EVENT_WINDOW)
+        self.compiles = old.compiles
 
     def set_page_info(self, usable: int, rect_pages_per_slot: int) -> None:
         """Paged-pool geometry (engine init / reset): enables the page
@@ -79,20 +199,23 @@ class ServeStats:
 
     def note_pages(self, used: int) -> None:
         """One per-tick occupancy sample (pages currently allocated)."""
-        self.page_peak = max(self.page_peak, int(used))
-        self._page_sum += int(used)
+        used = int(used)
+        self.pages_in_use = used
+        if used > self.page_peak:
+            self.page_peak = used
+        self._page_sum += used
         self._page_samples += 1
-
-    @property
-    def compiles(self) -> int:
-        return len(self.compile_events)
 
     def record_request(self, submit_t: float, admit_t: float, done_t: float,
                        n_tokens: int) -> None:
         self.retired += 1
         self.gen_tokens += int(n_tokens)
-        self.wait_s.append(admit_t - submit_t)
-        self.latency_s.append(done_t - submit_t)
+        wait = admit_t - submit_t
+        latency = done_t - submit_t
+        self.wait_s.append(wait)
+        self.latency_s.append(latency)
+        self.wait_hist.observe(wait)
+        self.latency_hist.observe(latency)
         if self.first_done_t is None:
             self.first_done_t = done_t
         self.last_done_t = done_t
@@ -106,6 +229,10 @@ class ServeStats:
         setattr(self, field, getattr(self, field) + 1)
 
     # ---------------- reporting ----------------
+
+    def prometheus(self) -> str:
+        """Prometheus text exposition of every serving metric."""
+        return self.registry.prometheus()
 
     def summary(self, wall_s: Optional[float] = None, n_chips: int = 1) -> Dict[str, float]:
         """Throughput is credited over ``wall_s`` when the caller measured a

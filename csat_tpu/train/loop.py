@@ -35,6 +35,7 @@ from csat_tpu.data.dataset import ASTDataset, Batch, iterate_batches
 from csat_tpu.data.vocab import Vocab, load_vocab
 from csat_tpu.metrics import batch_bleu, bleu_output_transform, eval_accuracies
 from csat_tpu.models import CSATrans
+from csat_tpu.obs import EventRecorder, MetricsRegistry
 from csat_tpu.parallel import build_mesh, shard_batch
 from csat_tpu.train.decode import greedy_decode
 from csat_tpu.train.loss import label_smoothing_loss
@@ -261,6 +262,21 @@ class ProgramCache:
         return prog(state, batch, bad_steps=bad_steps, loss_scale=loss_scale)
 
 
+def _timed_batches(batches: Iterable[Batch], obs: EventRecorder,
+                   annotate: bool = False) -> Iterator[Batch]:
+    """Wrap a batch iterator so the time spent WAITING on it (collate +
+    host→device transfer not hidden by the prefetch pipeline) is recorded
+    as ``train.data`` phase spans — the host-input share of the step."""
+    it = iter(batches)
+    while True:
+        with obs.span("train.data", annotate=annotate):
+            try:
+                batch = next(it)
+            except StopIteration:
+                return
+        yield batch
+
+
 def _pad_batch(batch: Batch, size: int, max_src_len: Optional[int] = None) -> Tuple[Batch, int]:
     """Pad a ragged tail batch to ``size`` rows so it reuses the compiled
     decode program instead of re-jitting (r2 verdict: the tail re-jit at
@@ -416,7 +432,23 @@ class Trainer:
 
     def __init__(self, cfg: Config, log: Callable[[str], None] = print):
         self.cfg = cfg
-        self.log = log
+        # unified telemetry (csat_tpu/obs, ISSUE 7): a metrics registry
+        # backing the history counters (Prometheus-exposable via
+        # self.registry.prometheus()) and a flight recorder of train-step
+        # phases + resilience actions. Trainer.log routes through the
+        # recorder so the free-text log lines land in the same timeline as
+        # the structured events (and still reach the caller's sink).
+        self.registry = MetricsRegistry()
+        self.obs = EventRecorder(capacity=cfg.obs_events, component="train")
+        self._log_sink = log
+        self.log = self._log
+        self.metrics_file = None
+        if cfg.obs_metrics_file:
+            from csat_tpu.obs import MetricsFile
+
+            self.metrics_file = MetricsFile(
+                cfg.obs_metrics_file, self.registry,
+                every_s=cfg.obs_metrics_every_s)
         if cfg.compilation_cache_dir:
             # persistent XLA compile cache (utils/cache.py): restarted /
             # resumed runs — and every bucket shape after the first run —
@@ -455,6 +487,27 @@ class Trainer:
         # tests (None = the production abort, os._exit(EXIT_WATCHDOG))
         self.fault_injector = None
         self.watchdog_on_timeout = None
+
+    def _log(self, msg: str) -> None:
+        """Log sink wrapper: every Trainer log line is also a flight-recorder
+        event, so the human-readable narrative interleaves with the
+        structured timeline in post-mortems and trace exports."""
+        self.obs.emit("log", msg=msg)
+        self._log_sink(msg)
+
+    def _postmortem(self, reason: str) -> None:
+        """Dump the flight recorder on a training fault path (rollback,
+        divergence, watchdog trip). Rolling per-reason file; never raises."""
+        pm = self.cfg.obs_postmortem_dir
+        if pm == "auto":
+            pm = os.path.join(self.output_dir, "postmortem")
+        if pm:
+            self.obs.postmortem(pm, reason)
+
+    def _watchdog_trip(self, what: str, stalled_s: float) -> None:
+        self.obs.emit("fault.watchdog", what=what,
+                      stalled_s=round(stalled_s, 3))
+        self._postmortem("watchdog")
 
     def _commit(self, state: TrainState) -> TrainState:
         """Commit a host-built state to the mesh (fully replicated).
@@ -607,11 +660,13 @@ class Trainer:
 
         self.log(f"preemption: saving synchronous snapshot "
                  f"(epoch {epoch}, {it_done} iterations done) under {ck_dir}")
-        retry(save_state, preempt_dir(ck_dir), state,
-              snapshot_step(epoch, it_done),
-              attempts=self.cfg.save_retries,
-              backoff_s=self.cfg.save_retry_backoff_s,
-              desc="preemption checkpoint", log=self.log)
+        self.obs.emit("fault.preemption", epoch=epoch, it_done=it_done)
+        with self.obs.span("train.checkpoint"):
+            retry(save_state, preempt_dir(ck_dir), state,
+                  snapshot_step(epoch, it_done),
+                  attempts=self.cfg.save_retries,
+                  backoff_s=self.cfg.save_retry_backoff_s,
+                  desc="preemption checkpoint", log=self.log)
         if jax.process_index() == 0:
             # the iteration count only addresses a position within THIS
             # plan's deterministic batch sequence — stamp the plan so a
@@ -720,8 +775,29 @@ class Trainer:
             history["bucket_programs"] = self._warm_bucket_programs(
                 state, example, train_ds)
 
+        # --- telemetry plumbing (csat_tpu/obs/) ---
+        # the resilience counters in `history` are registry-backed: every
+        # bump updates the dict (the existing return contract) AND the
+        # Prometheus-exposable counter, so a scrape of self.registry sees
+        # the same numbers the caller gets back
+        reg = self.registry
+
+        def bump(key: str, n: int = 1) -> None:
+            history[key] += n
+            reg.counter(f"train_{key}_total").inc(n)
+
+        steps_total = reg.counter(
+            "train_steps_total", "train-step attempts (incl. replays)")
+        epochs_total = reg.counter("train_epochs_total", "completed epochs")
+        loss_gauge = reg.gauge("train_epoch_loss", "last epoch's mean loss")
+        bleu_gauge = reg.gauge("train_val_bleu", "last validation BLEU")
+        obs = self.obs
+
         # --- resilience plumbing (csat_tpu/resilience/) ---
         injector = self.fault_injector
+        if injector is not None and getattr(injector, "recorder", None) is None:
+            # injected faults land in the same timeline as their effects
+            injector.recorder = obs
         guard_on = cfg.nonfinite_guard
         rollback_after = cfg.guard_rollback_after if guard_on else 0
         preempt = PreemptionHandler()
@@ -768,7 +844,8 @@ class Trainer:
                     diag_path=os.path.join(
                         self.output_dir, "watchdog_diagnostics.txt"),
                     log=self.log,
-                    probe=probe))
+                    probe=probe,
+                    on_trip=self._watchdog_trip))
             for epoch in range(start_epoch, num_epochs + 1):
                 if preempt.triggered:
                     # signal arrived between epochs (validation/checkpoint
@@ -781,8 +858,16 @@ class Trainer:
                 # and snap_it records which iteration position the anchor
                 # corresponds to, so a rollback replays only the window
                 # since the snapshot instead of the whole epoch
-                snapshot = host_snapshot(state) if rollback_after else None
+                if rollback_after:
+                    with obs.span("train.snapshot"):
+                        snapshot = host_snapshot(state)
+                else:
+                    snapshot = None
                 snap_it = skip_iterations if epoch == start_epoch else 0
+                # host spans get jax.profiler.TraceAnnotation brackets
+                # during the profiled epoch so they line up with the
+                # device trace (csat_tpu/obs/trace.py)
+                annotate = cfg.profile and epoch == start_epoch
                 if cfg.profile and epoch == start_epoch:
                     # one profiled epoch: the jax.profiler trace is the TPU
                     # analogue of the reference's torch.cuda.Event harness
@@ -824,14 +909,21 @@ class Trainer:
 
                         batches = itertools.islice(batches, skip, None)
                     it_done = skip
-                    for it, batch in enumerate(prefetch_batches(
-                        batches, self.mesh, depth=cfg.prefetch,
+                    for it, batch in enumerate(_timed_batches(
+                        prefetch_batches(batches, self.mesh, depth=cfg.prefetch),
+                        obs, annotate=annotate,
                     )):
                         loss_scale = injector.loss_scale(global_step) if injector else None
                         if injector is not None:
                             injector.maybe_hang(global_step)
-                        state, metrics = self.program_cache(
-                            state, batch, bad_steps=bad_dev, loss_scale=loss_scale)
+                        # span covers the DISPATCH (async): the device-side
+                        # step time shows up in the guard sync / profiler
+                        # trace, never as an extra host block
+                        with obs.span("train.step", annotate=annotate):
+                            state, metrics = self.program_cache(
+                                state, batch, bad_steps=bad_dev,
+                                loss_scale=loss_scale)
+                        steps_total.inc()
                         # guard-off steps emit no bad_steps: KEEP the
                         # committed zero instead of degrading to None →
                         # fresh uncommitted scalar → second compile (the
@@ -844,9 +936,11 @@ class Trainer:
                         finite = jnp.isfinite(last_loss)
                         loss_sum = loss_sum + jnp.where(finite, last_loss, 0.0)
                         loss_cnt = loss_cnt + finite
-                        if it % 50 == 0 and cfg.scalar_log:
-                            # per-iteration scalar cadence mirrors the reference's
-                            # every-50-iters TensorBoard loss (train.py:212-217).
+                        if (cfg.scalar_log and cfg.scalar_log_every
+                                and it % cfg.scalar_log_every == 0):
+                            # per-iteration scalar cadence (scalar_log_every;
+                            # the reference logged every 50 iters,
+                            # train.py:212-217; 0 turns the it-records off).
                             # Gated on scalar_log so the float() device sync never
                             # stalls the async dispatch pipeline when nobody reads it
                             self._scalar(epoch=epoch, it=it, loss=float(metrics["loss"]))
@@ -864,9 +958,12 @@ class Trainer:
                             # on good); the read is a host-device sync, so
                             # guard_check_every trades detection latency
                             # against async-dispatch overlap
-                            bad = int(metrics["bad_steps"])
+                            with obs.span("train.guard", annotate=annotate):
+                                bad = int(metrics["bad_steps"])
                             if bad > 0:
-                                history["nonfinite_steps"] += 1
+                                bump("nonfinite_steps")
+                                obs.emit("fault.nan_guard", epoch=epoch,
+                                         it=it, consecutive=bad)
                                 self.log(
                                     f"guard: non-finite step skipped (epoch "
                                     f"{epoch} it {it}; {bad} consecutive)")
@@ -886,17 +983,24 @@ class Trainer:
                                 # the state is good — anchoring a state
                                 # the guard has not vetted would roll
                                 # back INTO the divergence
-                                snapshot = host_snapshot(state)
+                                with obs.span("train.snapshot"):
+                                    snapshot = host_snapshot(state)
                                 snap_it = it_done
                                 snap_loss = (loss_sum, loss_cnt)
-                                history["step_snapshots"] += 1
+                                bump("step_snapshots")
                             if rollback_after and bad >= rollback_after:
                                 if history["rollbacks"] >= cfg.guard_max_rollbacks:
+                                    obs.emit("fault.diverged", epoch=epoch,
+                                             it=it, consecutive=bad,
+                                             rollbacks=history["rollbacks"])
+                                    self._postmortem("diverged")
                                     raise TrainingDivergedError(
                                         f"{bad} consecutive non-finite steps "
                                         f"after {history['rollbacks']} rollbacks "
                                         f"(epoch {epoch} it {it}) — aborting")
-                                history["rollbacks"] += 1
+                                bump("rollbacks")
+                                obs.emit("fault.rollback", epoch=epoch, it=it,
+                                         consecutive=bad, replay_from=snap_it)
                                 # snapshots live on host — recommit so the
                                 # replay reuses the compiled step program
                                 state = self._commit(restore_snapshot(
@@ -914,6 +1018,7 @@ class Trainer:
                                     f"epoch {epoch} it {it}; restored the "
                                     f"snapshot at iteration {snap_it} with a "
                                     "re-split rng; replaying from there")
+                                self._postmortem("rollback")
                                 break
                     if not rolled_back:
                         break
@@ -924,6 +1029,18 @@ class Trainer:
                 if cfg.profile and epoch == start_epoch and last_loss is not None:
                     jax.block_until_ready(last_loss)
                     jax.profiler.stop_trace()
+                    # host-side companion to the device trace: the recorded
+                    # train.* phase spans as Chrome trace-event JSON, openable
+                    # in Perfetto next to the jax.profiler trace (the
+                    # TraceAnnotation brackets carry the same names)
+                    if jax.process_index() == 0:
+                        from csat_tpu.obs.trace import write_chrome_trace
+
+                        os.makedirs(self.output_dir, exist_ok=True)
+                        write_chrome_trace(
+                            os.path.join(self.output_dir, "host_trace.json"),
+                            obs)
+                epochs_total.inc()
                 # finite-gated running mean == nanmean of the per-step list
                 # on any epoch: identical to the plain mean on healthy ones,
                 # and a guarded run's skipped steps can log NaN losses
@@ -931,14 +1048,17 @@ class Trainer:
                 cnt = float(loss_cnt)
                 mean_loss = float(loss_sum) / cnt if cnt else float("nan")
                 history["loss"].append(mean_loss)
+                loss_gauge.set(mean_loss)
                 self._scalar(epoch=epoch, loss=mean_loss, wall_s=round(time.time() - t0, 1))
                 msg = f"epoch {epoch}: loss={mean_loss:.4f} ({time.time()-t0:.1f}s)"
                 if val_ds is not None and (epoch % cfg.val_interval == 0 or epoch == num_epochs):
-                    bleu = evaluate_bleu(
-                        self.model, state.params, val_ds, cfg, self.tgt_vocab, eval_key,
-                        self.decode_fn, mesh=self.mesh,
-                    )
+                    with obs.span("train.eval"):
+                        bleu = evaluate_bleu(
+                            self.model, state.params, val_ds, cfg, self.tgt_vocab, eval_key,
+                            self.decode_fn, mesh=self.mesh,
+                        )
                     history["val_bleu"].append((epoch, bleu))
+                    bleu_gauge.set(bleu)
                     self._scalar(epoch=epoch, val_bleu=bleu)
                     if bleu > history["best_bleu"]:
                         history["best_bleu"] = bleu
@@ -953,9 +1073,20 @@ class Trainer:
                                 json.dump({"bleu": bleu, "epoch": epoch}, f)
                     msg += f" val_bleu={bleu:.4f}"
                 if checkpoint_fn is not None and epoch % cfg.save_interval == 0:
-                    checkpoint_fn(state, epoch)
+                    with obs.span("train.checkpoint"):
+                        checkpoint_fn(state, epoch)
                 self.log(msg)
+                if self.metrics_file is not None and jax.process_index() == 0:
+                    self.metrics_file.maybe_write(extra={"epoch": epoch},
+                                                  force=True)
         history["quarantined"] = budget.count
+        reg.counter("train_quarantined_total").value = budget.count
+        # per-phase wall-clock aggregate (the train analogue of the serve
+        # bench's phase-time breakdown); cumulative over this Trainer's
+        # recorder, which is per-fit for the normal one-fit lifecycle
+        history["phase_s"] = {
+            name: rec["total_s"] for name, rec in obs.phase_totals().items()
+            if name.startswith("train.")}
         if checkpoint_fn is not None:
             # epoch snapshots persist asynchronously (checkpoint.py) —
             # make them durable before handing the state back; scoped to

@@ -1,0 +1,344 @@
+"""Unified telemetry (ISSUE 7): metrics registry, flight recorder, trace
+export, and their wiring through the Trainer.
+
+* **exposition golden** — the Prometheus text format is a wire contract
+  (a router scrapes it); the golden test pins it byte-for-byte;
+* **flight recorder** — bounded ring, span totals that survive
+  wraparound, rolling post-mortem dumps;
+* **trace schema** — exported Chrome trace-event JSON validates (sorted
+  ts, complete X events) and rejects malformed traces;
+* **train integration** — one micro fit with profiling: phase spans
+  recorded, registry-backed history counters, the ``scalar_log_every``
+  knob, and a valid ``host_trace.json`` companion to the device trace.
+
+The serve-engine half of the integration surface (tick-phase spans,
+post-mortem dumps in every fault drill) lives in tests/test_serve.py
+where a compiled engine already exists.
+"""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from csat_tpu.obs import (
+    EventRecorder,
+    MetricsFile,
+    MetricsRegistry,
+    load_chrome_trace,
+    to_chrome_events,
+    validate_chrome_trace,
+    write_chrome_trace,
+)
+
+
+# ---------------------------------------------------------------------------
+# metrics registry + Prometheus exposition
+# ---------------------------------------------------------------------------
+
+
+def test_prometheus_exposition_golden():
+    """Byte-for-byte exposition contract: HELP/TYPE headers, counter and
+    gauge samples, cumulative histogram buckets with +Inf, sum and count.
+    (Observed values are binary-exact so the sum formats predictably.)"""
+    reg = MetricsRegistry()
+    reg.counter("requests_total", "total requests served").inc(3)
+    reg.gauge("queue_depth").set(2)
+    h = reg.histogram("latency_seconds", "request latency",
+                      buckets=(0.25, 1.0))
+    h.observe(0.125)
+    h.observe(0.5)
+    h.observe(2.0)
+    assert reg.prometheus() == (
+        "# HELP requests_total total requests served\n"
+        "# TYPE requests_total counter\n"
+        "requests_total 3\n"
+        "# TYPE queue_depth gauge\n"
+        "queue_depth 2\n"
+        "# HELP latency_seconds request latency\n"
+        "# TYPE latency_seconds histogram\n"
+        'latency_seconds_bucket{le="0.25"} 1\n'
+        'latency_seconds_bucket{le="1"} 2\n'
+        'latency_seconds_bucket{le="+Inf"} 3\n'
+        "latency_seconds_sum 2.625\n"
+        "latency_seconds_count 3\n"
+    )
+
+
+def test_registry_get_or_create_and_type_conflict():
+    reg = MetricsRegistry()
+    assert reg.counter("a_total") is reg.counter("a_total")
+    with pytest.raises(TypeError):
+        reg.gauge("a_total")
+    with pytest.raises(AssertionError):
+        reg.counter("bad name")
+
+
+def test_snapshot_flattens_histograms():
+    reg = MetricsRegistry()
+    reg.counter("c_total").inc(2)
+    reg.histogram("h_seconds", buckets=(1.0,)).observe(0.5)
+    snap = reg.snapshot()
+    assert snap == {"c_total": 2, "h_seconds_sum": 0.5, "h_seconds_count": 1}
+
+
+def test_metrics_file_cadence_and_force(tmp_path):
+    reg = MetricsRegistry()
+    c = reg.counter("ticks_total")
+    clock = {"t": 0.0}
+    mf = MetricsFile(str(tmp_path / "m.jsonl"), reg, every_s=10.0,
+                     clock=lambda: clock["t"])
+    assert mf.maybe_write()                 # first write always lands
+    c.inc()
+    clock["t"] = 5.0
+    assert not mf.maybe_write()             # inside the window: skipped
+    clock["t"] = 11.0
+    assert mf.maybe_write(extra={"queue_depth": 4})
+    assert mf.maybe_write(force=True)       # shutdown flush ignores cadence
+    with open(tmp_path / "m.jsonl") as f:
+        recs = [json.loads(line) for line in f]
+    assert [r["ticks_total"] for r in recs] == [0, 1, 1]
+    assert recs[1]["queue_depth"] == 4
+    assert all("t" in r for r in recs)
+
+
+def test_serve_stats_compile_events_bounded():
+    """Satellite: compile_events is a bounded window while `compiles`
+    carries the authoritative total — a server with periodic rebuilds
+    no longer grows the list forever."""
+    from csat_tpu.serve.stats import COMPILE_EVENT_WINDOW, ServeStats
+
+    s = ServeStats(4)
+    n = COMPILE_EVENT_WINDOW + 17
+    for i in range(n):
+        s.record_compile("prefill", (i,))
+    assert s.compiles == n
+    assert len(s.compile_events) == COMPILE_EVENT_WINDOW
+    assert s.compile_events[-1] == ("prefill", (n - 1,))
+    # registry backing: the same total is scrapeable
+    assert f"serve_compiled_programs_total {n}" in s.prometheus()
+
+
+# ---------------------------------------------------------------------------
+# flight recorder
+# ---------------------------------------------------------------------------
+
+
+def test_ring_bounded_and_totals_survive_wrap():
+    rec = EventRecorder(capacity=3, component="t")
+    for i in range(7):
+        rec.span_from(f"phase.{i % 2}", rec.perf_t0)
+    assert len(rec.events()) == 3            # ring keeps the newest 3
+    totals = rec.phase_totals()
+    assert totals["phase.0"]["count"] == 4   # aggregates saw all 7
+    assert totals["phase.1"]["count"] == 3
+
+
+def test_disabled_recorder_is_inert():
+    rec = EventRecorder(capacity=0)
+    rec.emit("x", id=1)
+    with rec.span("s"):
+        pass
+    assert not rec.enabled and rec.events() == []
+    assert rec.postmortem("/nonexistent", "FAILED") is None
+
+
+def test_dump_roundtrip_and_rolling_postmortem(tmp_path):
+    rec = EventRecorder(capacity=16, component="serve")
+    rec.emit("req.submit", id=7)
+    with rec.span("tick.decode_dispatch", live=2):
+        pass
+    rec.emit("req.failed", id=7, error="boom")
+    path = rec.postmortem(str(tmp_path), "FAILED")
+    meta, events = EventRecorder.load(path)
+    assert meta["component"] == "serve" and meta["reason"] == "FAILED"
+    assert [e["name"] for e in events] == [
+        "req.submit", "tick.decode_dispatch", "req.failed"]
+    assert events[0]["id"] == 7 and events[2]["error"] == "boom"
+    assert events[1]["dur"] >= 0
+    # rolling: a second incident of the same class OVERWRITES the file
+    # (newest timeline wins), a different class gets its own file
+    rec.emit("req.failed", id=8)
+    assert rec.postmortem(str(tmp_path), "FAILED") == path
+    rec.postmortem(str(tmp_path), "watchdog")
+    names = sorted(os.listdir(tmp_path))
+    assert names == ["postmortem_serve_FAILED.jsonl",
+                     "postmortem_serve_watchdog.jsonl"]
+    _, events2 = EventRecorder.load(path)
+    assert events2[-1]["id"] == 8 and rec.dumps_written == 3
+
+
+# ---------------------------------------------------------------------------
+# trace export + schema validation
+# ---------------------------------------------------------------------------
+
+
+def test_trace_export_valid_and_grouped(tmp_path):
+    rec = EventRecorder(capacity=64, component="serve")
+    rec.emit("req.submit", id=1)
+    with rec.span("tick.admit"):
+        with rec.span("prefill.n24", rows=1):
+            pass
+    with rec.span("tick.decode_dispatch"):
+        pass
+    path = write_chrome_trace(str(tmp_path / "t.json"), rec)
+    obj = load_chrome_trace(path)
+    assert validate_chrome_trace(obj) == []
+    evs = obj["traceEvents"]
+    names = {e["name"] for e in evs}
+    assert {"req.submit", "tick.admit", "prefill.n24",
+            "tick.decode_dispatch"} <= names
+    # dot-prefix grouping: tick.* share a tid distinct from prefill.*
+    by_name = {e["name"]: e for e in evs if e.get("ph") in ("X", "i")}
+    assert by_name["tick.admit"]["tid"] == by_name["tick.decode_dispatch"]["tid"]
+    assert by_name["tick.admit"]["tid"] != by_name["prefill.n24"]["tid"]
+    # thread_name metadata present for every pseudo-thread
+    threads = {e["args"]["name"] for e in evs if e["ph"] == "M"
+               and e["name"] == "thread_name"}
+    assert {"req", "tick", "prefill"} <= threads
+    # span args survive into the trace
+    assert by_name["prefill.n24"]["args"] == {"rows": 1}
+
+
+def test_trace_validation_rejects_malformed():
+    ok = {"traceEvents": [
+        {"name": "a", "ph": "X", "ts": 0, "dur": 5, "pid": 1, "tid": 1},
+        {"name": "b", "ph": "B", "ts": 6, "pid": 1, "tid": 1},
+        {"name": "b", "ph": "E", "ts": 9, "pid": 1, "tid": 1},
+    ]}
+    assert validate_chrome_trace(ok) == []
+    assert validate_chrome_trace({"traceEvents": "nope"})
+    assert validate_chrome_trace({"traceEvents": [
+        {"name": "a", "ph": "X", "ts": 0, "pid": 1}]})  # X without dur
+    assert validate_chrome_trace({"traceEvents": [
+        {"name": "a", "ph": "i", "ts": 10, "pid": 1},
+        {"name": "b", "ph": "i", "ts": 3, "pid": 1}]})  # unsorted ts
+    assert validate_chrome_trace({"traceEvents": [
+        {"name": "b", "ph": "B", "ts": 0, "pid": 1, "tid": 1}]})  # unclosed B
+    assert validate_chrome_trace({"traceEvents": [
+        {"name": "e", "ph": "E", "ts": 0, "pid": 1, "tid": 1}]})  # E sans B
+    assert validate_chrome_trace({"traceEvents": [
+        {"name": "a", "ph": "?", "ts": 0}]})  # unknown phase
+
+
+# ---------------------------------------------------------------------------
+# tools/obs_report.py
+# ---------------------------------------------------------------------------
+
+
+def test_obs_report_renders_phase_and_outcome_tables(tmp_path, capsys):
+    from tools import obs_report
+
+    rec = EventRecorder(capacity=64, component="serve")
+    rec.emit("req.submit", id=0)
+    rec.emit("req.ok", id=0, n_tokens=3)
+    rec.emit("req.failed", id=1, error="x")
+    with rec.span("tick.decode_dispatch"):
+        pass
+    dump = rec.dump(str(tmp_path / "events.jsonl"), reason="drill")
+
+    reg = MetricsRegistry()
+    reg.counter("serve_requests_submitted_total").inc(2)
+    mf = MetricsFile(str(tmp_path / "metrics.jsonl"), reg, every_s=0.0)
+    mf.maybe_write(force=True)
+
+    obs_report.main(["--metrics", str(tmp_path / "metrics.jsonl"),
+                     "--events", dump])
+    out = capsys.readouterr().out
+    assert "serve_requests_submitted_total" in out
+    assert "tick.decode_dispatch" in out
+    assert "req.failed" in out and "req.ok" in out
+
+    # the same report runs on a Chrome trace export
+    trace = write_chrome_trace(str(tmp_path / "trace.json"), rec)
+    obs_report.main(["--events", trace])
+    out = capsys.readouterr().out
+    assert "tick.decode_dispatch" in out
+
+    ph = obs_report.phase_table(
+        [{"name": "a", "dur": 0.5}, {"name": "a", "dur": 1.5},
+         {"name": "i"}])
+    assert ph["a"]["count"] == 2 and ph["a"]["total_s"] == 2.0
+
+
+# ---------------------------------------------------------------------------
+# Trainer integration: phases, registry-backed history, scalar cadence,
+# host-trace export next to the device profile
+# ---------------------------------------------------------------------------
+
+
+def test_trainer_telemetry_end_to_end(synthetic_corpus, micro_config, tmp_path):
+    from csat_tpu.data.dataset import ASTDataset
+    from csat_tpu.train import Trainer
+
+    cfg = micro_config.replace(
+        data_dir=synthetic_corpus, full_att=True, num_epochs=1,
+        val_interval=99, save_interval=99, profile=True,
+        scalar_log=True, scalar_log_every=5,
+        output_dir=str(tmp_path),
+    )
+    logged = []
+    trainer = Trainer(cfg, log=logged.append)
+    ds = ASTDataset(cfg, "train", trainer.src_vocab, trainer.tgt_vocab)
+    _, history = trainer.fit(ds, None)
+
+    # registry-backed counters agree with the history dict contract
+    snap = trainer.registry.snapshot()
+    assert snap["train_steps_total"] == 12   # 96 samples / batch 8
+    assert snap["train_epochs_total"] == 1
+    assert np.isfinite(snap["train_epoch_loss"])
+    assert "# TYPE train_steps_total counter" in trainer.registry.prometheus()
+
+    # phase-time breakdown covers the step pipeline
+    assert {"train.data", "train.step"} <= set(history["phase_s"])
+    assert all(v >= 0 for v in history["phase_s"].values())
+
+    # Trainer.log routes through the flight recorder: the free-text lines
+    # appear as `log` events in the same timeline AND still reach the sink
+    assert logged, "log sink starved"
+    log_events = [f["msg"] for _, name, _, f in trainer.obs.events()
+                  if name == "log"]
+    assert logged[-1] in log_events
+
+    # scalar_log_every=5 → per-iteration records at it 0, 5, 10
+    with open(os.path.join(trainer.output_dir, "scalars.jsonl")) as f:
+        its = [r["it"] for r in map(json.loads, f) if "it" in r]
+    assert its == [0, 5, 10]
+
+    # the profiled epoch leaves BOTH traces: the device profile dir and the
+    # host-span Chrome trace with matching phase names
+    assert os.listdir(os.path.join(trainer.output_dir, "trace"))
+    host = os.path.join(trainer.output_dir, "host_trace.json")
+    obj = load_chrome_trace(host)
+    assert validate_chrome_trace(obj) == []
+    names = {e["name"] for e in obj["traceEvents"]}
+    assert {"train.data", "train.step"} <= names
+
+
+def test_scalar_log_every_zero_disables_iteration_records(
+        synthetic_corpus, micro_config, tmp_path):
+    """scalar_log_every=0: the epoch records still stream, the per-iteration
+    ones are off (the old hard-coded `it % 50` had no off switch)."""
+    from csat_tpu.data.dataset import ASTDataset
+    from csat_tpu.train import Trainer
+
+    cfg = micro_config.replace(
+        data_dir=synthetic_corpus, full_att=True, num_epochs=1,
+        val_interval=99, save_interval=99,
+        scalar_log=True, scalar_log_every=0,
+        output_dir=str(tmp_path),
+    )
+    trainer = Trainer(cfg, log=lambda s: None)
+    ds = ASTDataset(cfg, "train", trainer.src_vocab, trainer.tgt_vocab)
+    trainer.fit(ds, None)
+    with open(os.path.join(trainer.output_dir, "scalars.jsonl")) as f:
+        recs = [json.loads(line) for line in f]
+    assert not any("it" in r for r in recs)
+    assert any("loss" in r and r.get("epoch") == 1 for r in recs)
+
+
+def test_event_tuples_to_chrome_instant_scope():
+    evs = to_chrome_events([(1.0, "req.submit", 0.0, {"id": 3})])
+    inst = [e for e in evs if e["ph"] == "i"]
+    assert inst and inst[0]["s"] == "t" and inst[0]["args"] == {"id": 3}

@@ -108,6 +108,21 @@ def test_rollback_after_k_consecutive_and_quarantine(rig):
     assert hist["nonfinite_steps"] == 2
     assert hist["quarantined"] == 1
     assert np.isfinite(hist["loss"][0])
+    # flight recorder (ISSUE 7): the rollback left a post-mortem timeline
+    # with the injected cause, the guard's reaction and the rollback itself,
+    # and the registry-backed counters mirror the history dict
+    from csat_tpu.obs import EventRecorder
+
+    pm = os.path.join(trainer.output_dir, "postmortem",
+                      "postmortem_train_rollback.jsonl")
+    assert os.path.exists(pm), "rollback did not dump a post-mortem"
+    _, events = EventRecorder.load(pm)
+    names = [e["name"] for e in events]
+    assert "fault.injected.nan_loss" in names
+    assert "fault.nan_guard" in names and "fault.rollback" in names
+    snap = trainer.registry.snapshot()
+    assert snap["train_rollbacks_total"] >= 1
+    assert snap["train_nonfinite_steps_total"] >= 2
     # first attempt: 12 batches - 1 quarantined, NaN at attempts 5-6 →
     # rollback to the step-0 snapshot; replay attempt: all 12 batches
     # clean (fault ordinals are global, the quarantine ordinal was already
@@ -202,6 +217,16 @@ def test_watchdog_trips_on_hung_step(rig):
     assert os.path.exists(
         os.path.join(trainer.output_dir, "watchdog_diagnostics.txt"))
     assert np.isfinite(hist["loss"][0])
+    # the trip's flight-recorder dump (written from the monitor thread,
+    # while the training loop was still stalled) carries cause and effect
+    from csat_tpu.obs import EventRecorder
+
+    pm = os.path.join(trainer.output_dir, "postmortem",
+                      "postmortem_train_watchdog.jsonl")
+    assert os.path.exists(pm), "watchdog trip did not dump a post-mortem"
+    _, events = EventRecorder.load(pm)
+    names = [e["name"] for e in events]
+    assert "fault.watchdog" in names and "fault.injected.hang" in names
 
 
 # --------------------------------------------------------------------------

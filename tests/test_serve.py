@@ -366,20 +366,45 @@ class FakeClock:
 
 
 @pytest.fixture(scope="module")
-def drilled(served):
+def drilled(served, tmp_path_factory):
     """A dedicated engine over the SAME model/params as ``served`` (so the
     fresh-decode references compare apples to apples), with a fake clock
     and a recording tick watchdog. Tests mutate ``engine.cfg`` /
     ``engine.fault_injector`` for their scenario and leave the pool
-    drained."""
+    drained.  Post-mortem dumps land in a temp dir so each drill can
+    assert the flight recorder left a timeline (ISSUE 7)."""
     cfg0, model, params, _ = served
-    cfg = cfg0.replace(serve_watchdog_timeout_s=3.0)
+    cfg = cfg0.replace(
+        serve_watchdog_timeout_s=3.0,
+        obs_postmortem_dir=str(tmp_path_factory.mktemp("serve_postmortem")))
     clock = FakeClock()
     tripped = threading.Event()
     eng = ServeEngine(model, params, cfg, clock=clock,
                       watchdog_on_timeout=tripped.set)
     yield cfg, model, params, eng, clock, tripped
     eng.close()
+
+
+def _postmortem_events(eng, reason):
+    """The rolling post-mortem dump for one fault class: every fault drill
+    must leave one (ISSUE 7 acceptance), and its event timeline is what
+    the assertions below inspect."""
+    import os
+
+    from csat_tpu.obs import EventRecorder
+
+    path = os.path.join(
+        eng._postmortem_dir, f"postmortem_serve_{reason}.jsonl")
+    assert os.path.exists(path), f"no post-mortem dump for {reason}"
+    meta, events = EventRecorder.load(path)
+    assert meta["component"] == "serve" and meta["reason"] == reason
+    return events
+
+
+def _lifecycle(events, req_id):
+    """The named lifecycle transitions of one request id, in order."""
+    return [e["name"] for e in events
+            if e["name"].startswith("req.") and e.get("id") == req_id]
 
 
 def _drill_reset(eng, cfg) -> None:
@@ -432,6 +457,12 @@ def test_poison_submit_quarantined_under_budget(drilled):
         assert req is not None and req.status == RequestStatus.FAILED
         assert "poison request" in req.error
         assert eng.stats.quarantined == 1
+        # the quarantine left a post-mortem timeline: submit → FAILED, with
+        # the poison fault event alongside
+        events = _postmortem_events(eng, "FAILED")
+        assert _lifecycle(events, rid_bad) == ["req.submit", "req.failed"]
+        assert any(e["name"] == "fault.poison" and e.get("id") == rid_bad
+                   for e in events)
 
         rid_bad2 = eng.submit(FaultInjector.poison_sample(good[0], "dtype"))
         assert eng.poll(rid_bad2).status == RequestStatus.FAILED
@@ -456,12 +487,16 @@ def test_queue_full_reject_and_shed_policies(drilled):
     rej = eng.poll(ids[2])
     assert rej.status == RequestStatus.REJECTED and "queue full" in rej.error
     assert eng.stats.rejected >= 1
+    assert _lifecycle(_postmortem_events(eng, "REJECTED"), ids[2]) == [
+        "req.submit", "req.rejected"]
 
     eng.cfg = cfg.replace(serve_max_queue=2, serve_queue_policy="shed_oldest")
     id3 = eng.submit(samples[3], max_new_tokens=2)
     assert eng.queue_depth == 2  # bounded: oldest went out, newest came in
     shed = eng.poll(ids[0])
     assert shed.status == RequestStatus.SHED and eng.stats.shed >= 1
+    assert _lifecycle(_postmortem_events(eng, "SHED"), ids[0]) == [
+        "req.submit", "req.shed"]
     eng.drain()
     for rid, sample in ((ids[1], samples[1]), (id3, samples[3])):
         req = eng.poll(rid)
@@ -498,6 +533,9 @@ def test_deadline_timeout_queued_and_in_flight(drilled):
     req = eng.poll(rid)
     assert req.status == RequestStatus.TIMEOUT and "in flight" in req.error
     assert 0 < req.n_tokens <= 8  # partial tokens delivered
+    # post-mortem carries the FULL lifecycle: submit → admit → timeout
+    assert _lifecycle(_postmortem_events(eng, "TIMEOUT"), rid) == [
+        "req.submit", "req.admit", "req.timeout"]
     np.testing.assert_array_equal(
         np.asarray(req.tokens),
         _fresh_decode(cfg, model, params, samples[1])[: req.n_tokens])
@@ -526,6 +564,14 @@ def test_nan_logits_retire_row_failed_others_exact(drilled):
     assert victim.n_tokens == 1  # poisoned at pos 1: one clean token kept
     ref0 = _fresh_decode(cfg, model, params, samples[0])
     np.testing.assert_array_equal(np.asarray(victim.tokens), ref0[:1])
+    # post-mortem: the victim's full lifecycle, the injected fault AND the
+    # guard's reaction in one timeline (cause next to effect)
+    events = _postmortem_events(eng, "FAILED")
+    assert _lifecycle(events, ids[0]) == [
+        "req.submit", "req.admit", "req.failed"]
+    names = [e["name"] for e in events]
+    assert "fault.injected.nan_logits" in names
+    assert "fault.nan_guard" in names
     for rid, sample in list(zip(ids, samples))[1:]:
         req = eng.poll(rid)
         assert req.status == RequestStatus.OK
@@ -555,6 +601,11 @@ def test_stuck_slot_reaped_not_wedged(drilled):
     assert victim.status == RequestStatus.FAILED
     assert "stuck slot reaped" in victim.error
     assert eng.stats.reaped == 1
+    events = _postmortem_events(eng, "FAILED")
+    assert _lifecycle(events, ids[0]) == [
+        "req.submit", "req.admit", "req.failed"]
+    names = [e["name"] for e in events]
+    assert "fault.injected.wedge_slot" in names and "fault.reap" in names
     for rid, sample in list(zip(ids, samples))[1:]:
         req = eng.poll(rid)
         assert req.status == RequestStatus.OK
@@ -584,6 +635,9 @@ def test_prefill_failure_fails_chunk_pool_still_serving(drilled):
     assert statuses[:n_failed] == [RequestStatus.FAILED] * n_failed
     assert all(s == RequestStatus.OK for s in statuses[n_failed:])
     assert "prefill failed" in eng.poll(ids[0]).error
+    events = _postmortem_events(eng, "FAILED")
+    assert any(e["name"] == "fault.injected.prefill_fail" for e in events)
+    assert _lifecycle(events, ids[0])[-1] == "req.failed"
     reqs = eng.generate(samples, max_new_tokens=3)  # same samples now serve
     assert all(r.status == RequestStatus.OK for r in reqs)
 
@@ -605,6 +659,9 @@ def test_device_fault_rebuilds_and_resubmits_bit_identical(drilled):
     eng.fault_injector = None
     assert eng.stats.rebuilds == 1
     assert eng.stats.compiles == compiles0, "rebuild must not recompile"
+    events = _postmortem_events(eng, "rebuild")
+    names = [e["name"] for e in events]
+    assert "fault.injected.decode_fail" in names and "fault.rebuild" in names
     for rid, sample in zip(ids, samples):
         req = eng.poll(rid)
         assert req.status == RequestStatus.OK
@@ -639,6 +696,10 @@ def test_device_fault_retries_exhausted_then_cap(drilled):
     eng.submit(samples[0], max_new_tokens=3)
     with pytest.raises(RuntimeError, match="serve_max_rebuilds"):
         eng.drain()
+    # the cap-exceeded path dumps BEFORE propagating — the process may be
+    # about to die, so the timeline must already be on disk
+    assert any(e["name"] == "fault.rebuild_cap"
+               for e in _postmortem_events(eng, "rebuild_cap"))
     eng.fault_injector = None
     eng._rebuilds = 0
     eng.drain()  # the un-faulted retry completes cleanly
@@ -663,6 +724,8 @@ def test_shed_all_resolves_everything(drilled):
     statuses = {eng.poll(r).status for r in ids}
     assert statuses == {RequestStatus.SHED}
     assert any(eng.poll(r).n_tokens > 0 for r in ids[: cfg.serve_slots])
+    events = _postmortem_events(eng, "SHED")
+    assert all(_lifecycle(events, r)[-1] == "req.shed" for r in ids)
     assert eng.generate(samples[:1], max_new_tokens=2)[0].status == RequestStatus.OK
 
 
@@ -727,6 +790,53 @@ def test_cli_stdin_line_reader_handles_bursts():
     os.close(r)
 
 
+def test_engine_prometheus_exposition_matches_summary(drilled):
+    """The registry-backed ServeStats exposes the same numbers summary()
+    reports — the per-replica scrape surface a router consumes."""
+    cfg, model, params, eng, clock, _ = drilled
+    _drill_reset(eng, cfg)
+    eng.generate(_bucket0_requests(cfg, 3, seed=30), max_new_tokens=2)
+    text = eng.stats.prometheus()
+    s = eng.stats.summary()
+    for line in (
+        f"serve_requests_submitted_total {s['submitted']}",
+        f"serve_requests_ok_total {s['retired']}",
+        f"serve_gen_tokens_total {s['gen_tokens']}",
+        f"serve_compiled_programs_total {s['compiles']}",
+        f"serve_slots {cfg.serve_slots}",
+    ):
+        assert f"\n{line}\n" in f"\n{text}", line
+    assert "# TYPE serve_request_latency_seconds histogram" in text
+    assert f'serve_request_latency_seconds_count {s["retired"]}' in text
+    # JSONL snapshot carries the same counters (the --metrics_file format)
+    snap = eng.stats.registry.snapshot()
+    assert snap["serve_requests_submitted_total"] == s["submitted"]
+    assert snap["serve_gen_tokens_total"] == s["gen_tokens"]
+
+
+def test_engine_trace_export_covers_phases_and_lifecycles(drilled, tmp_path):
+    """The exported Chrome trace validates against the trace-event schema
+    and covers the tick phases (admit / decode dispatch / status fetch),
+    the per-bucket prefill spans and the request lifecycles."""
+    from csat_tpu.obs import load_chrome_trace, validate_chrome_trace, write_chrome_trace
+
+    cfg, model, params, eng, clock, _ = drilled
+    _drill_reset(eng, cfg)
+    reqs = eng.generate(_requests(cfg, 5, seed=31), max_new_tokens=3)
+    assert all(r.status == RequestStatus.OK for r in reqs)
+    path = write_chrome_trace(str(tmp_path / "serve_trace.json"), eng.obs)
+    obj = load_chrome_trace(path)
+    assert validate_chrome_trace(obj) == [], validate_chrome_trace(obj)[:5]
+    names = {e["name"] for e in obj["traceEvents"]}
+    assert {"tick.retire", "tick.admit", "tick.decode_dispatch",
+            "tick.status_fetch"} <= names
+    assert any(n.startswith("prefill.n") for n in names)
+    assert {"req.submit", "req.admit", "req.ok"} <= names
+    # phase totals (ring-wrap-proof) agree with what the trace shows
+    totals = eng.obs.phase_totals()
+    assert totals["tick.decode_dispatch"]["count"] >= len(reqs)
+
+
 def test_tick_hang_trips_serve_watchdog(drilled):
     """A hung tick (the wedged-dispatch mode) trips the tick-liveness
     watchdog within its bounded window; the recorder action stands in for
@@ -743,3 +853,8 @@ def test_tick_hang_trips_serve_watchdog(drilled):
     assert tripped.is_set(), "hung tick did not trip the serve watchdog"
     # the hang cleared; the requests themselves still resolved OK
     assert all(r.status == RequestStatus.OK for r in reqs)
+    # the trip dumped from the MONITOR thread while the scheduler was still
+    # wedged — the timeline exists even if the process had been aborted
+    events = _postmortem_events(eng, "watchdog")
+    names = [e["name"] for e in events]
+    assert "fault.watchdog" in names and "fault.injected.hang_tick" in names

@@ -214,3 +214,14 @@ def test_observability_trace_and_scalars(synthetic_corpus, tiny_config, tmp_path
     with open(scalars) as f:
         recs = [json.loads(line) for line in f]
     assert any("loss" in r and r.get("epoch") == 1 for r in recs)
+
+    # ISSUE 7: the profiled epoch also exports the host-span timeline as
+    # valid Chrome trace-event JSON next to the device trace
+    from csat_tpu.obs import load_chrome_trace, validate_chrome_trace
+
+    host = os.path.join(trainer.output_dir, "host_trace.json")
+    assert os.path.exists(host), "no host trace exported"
+    obj = load_chrome_trace(host)
+    assert validate_chrome_trace(obj) == []
+    names = {e["name"] for e in obj["traceEvents"]}
+    assert {"train.data", "train.step"} <= names

@@ -1,0 +1,170 @@
+"""One-screen run report from the telemetry artifacts (ISSUE 7).
+
+Reads any combination of:
+
+* a **metrics JSONL** file (``csat_tpu/obs/metrics.py:MetricsFile`` — the
+  serve CLI's ``--metrics_file`` / the train CLI's ``--metrics_file``) and
+  renders the last snapshot as an outcome/counter table;
+* an **events file** — a flight-recorder dump (post-mortem JSONL,
+  ``csat_tpu/obs/events.py``) or a Chrome trace-event JSON export
+  (``csat_tpu/obs/trace.py``) — and renders a phase-time table
+  (count / total / mean / p95 per span name) plus the lifecycle outcome
+  counts found in the event stream.
+
+Usage::
+
+    python tools/obs_report.py --metrics serve_metrics.jsonl \
+        --events outputs/postmortem/postmortem_serve_FAILED.jsonl
+    python tools/obs_report.py --events outputs/.../host_trace.json
+
+Runs on the fast-gate artifacts in CI; ``bench.py`` computes its own
+phase-time breakdown from the recorder's running totals
+(``EventRecorder.totals``) so it needs no artifact round-trip —
+``phase_table`` here is the offline equivalent over a dump/trace file.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+from typing import Dict, Iterable, List, Optional, Tuple
+
+from csat_tpu.serve.stats import percentile
+
+
+def load_metrics(path: str) -> List[dict]:
+    """All snapshots in a metrics JSONL file, oldest first."""
+    out = []
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if line:
+                out.append(json.loads(line))
+    return out
+
+
+def load_events(path: str) -> Tuple[dict, List[dict]]:
+    """(meta, events) from either a flight-recorder JSONL dump or a Chrome
+    trace JSON file — both normalize to dicts with ``name``/``dur``
+    (seconds) and optional extra fields."""
+    with open(path) as f:
+        head = f.read(1).strip()
+    if head == "{":
+        # could be a one-object trace file OR a JSONL dump whose first line
+        # is the {"meta": ...} header — try the whole-file JSON parse first
+        try:
+            with open(path) as f:
+                obj = json.load(f)
+            if "traceEvents" in obj:
+                events = []
+                for ev in obj["traceEvents"]:
+                    if ev.get("ph") == "M":
+                        continue
+                    rec = {"name": ev.get("name"),
+                           "ts": ev.get("ts", 0.0) / 1e6}
+                    if ev.get("ph") == "X":
+                        rec["dur"] = ev.get("dur", 0.0) / 1e6
+                    rec.update(ev.get("args") or {})
+                    events.append(rec)
+                return {"source": "chrome-trace"}, events
+        except json.JSONDecodeError:
+            pass
+    from csat_tpu.obs.events import EventRecorder
+
+    return EventRecorder.load(path)
+
+
+def phase_table(events: Iterable[dict]) -> Dict[str, Dict[str, float]]:
+    """Aggregate span events by name: count, total seconds, mean and p95
+    milliseconds. Instant events (no ``dur``) are excluded."""
+    durs: Dict[str, List[float]] = {}
+    for ev in events:
+        d = ev.get("dur")
+        if d is None:
+            continue
+        durs.setdefault(ev["name"], []).append(float(d))
+    return {
+        name: {
+            "count": len(ds),
+            "total_s": round(sum(ds), 4),
+            "mean_ms": round(sum(ds) / len(ds) * 1e3, 3),
+            "p95_ms": round(percentile(ds, 95) * 1e3, 3),
+        }
+        for name, ds in sorted(durs.items())
+    }
+
+
+def outcome_counts(events: Iterable[dict]) -> Dict[str, int]:
+    """Request-lifecycle outcome counts from ``req.*`` instant events."""
+    out: Dict[str, int] = {}
+    for ev in events:
+        name = ev.get("name", "")
+        if name.startswith(("req.", "fault.")):
+            out[name] = out.get(name, 0) + 1
+    return dict(sorted(out.items()))
+
+
+def _fmt_table(rows: List[Tuple], headers: Tuple) -> str:
+    rows = [tuple(str(c) for c in r) for r in rows]
+    widths = [max(len(h), *(len(r[i]) for r in rows)) if rows else len(h)
+              for i, h in enumerate(headers)]
+    def line(cells):
+        return "  ".join(c.ljust(w) for c, w in zip(cells, widths)).rstrip()
+    sep = "  ".join("-" * w for w in widths)
+    return "\n".join([line(headers), sep] + [line(r) for r in rows])
+
+
+def report(metrics_path: Optional[str] = None,
+           events_path: Optional[str] = None) -> str:
+    """The one-screen report as a string (main() prints it)."""
+    sections: List[str] = []
+    if metrics_path:
+        snaps = load_metrics(metrics_path)
+        if snaps:
+            last = snaps[-1]
+            rows = [(k, v) for k, v in sorted(last.items()) if k != "t"]
+            sections.append(
+                f"== metrics ({metrics_path}: {len(snaps)} snapshot(s), "
+                f"showing last) ==\n" + _fmt_table(rows, ("metric", "value")))
+            # latency percentiles when the serving histograms are present
+            lat_sum = last.get("serve_request_latency_seconds_sum")
+            lat_n = last.get("serve_request_latency_seconds_count")
+            if lat_n:
+                sections.append(
+                    f"mean OK latency: {lat_sum / lat_n * 1e3:.1f} ms "
+                    f"over {lat_n} request(s)")
+    if events_path:
+        meta, events = load_events(events_path)
+        title = meta.get("component") or meta.get("source") or "events"
+        if meta.get("reason"):
+            title += f" (reason: {meta['reason']})"
+        phases = phase_table(events)
+        if phases:
+            rows = [(n, p["count"], p["total_s"], p["mean_ms"], p["p95_ms"])
+                    for n, p in phases.items()]
+            sections.append(
+                f"== phase time — {title} ({events_path}) ==\n" + _fmt_table(
+                    rows, ("phase", "count", "total_s", "mean_ms", "p95_ms")))
+        outcomes = outcome_counts(events)
+        if outcomes:
+            sections.append("== outcomes/faults ==\n" + _fmt_table(
+                list(outcomes.items()), ("event", "count")))
+        if not phases and not outcomes:
+            sections.append(f"(no span or lifecycle events in {events_path})")
+    if not sections:
+        sections.append("nothing to report: pass --metrics and/or --events")
+    return "\n\n".join(sections)
+
+
+def main(argv: Optional[List[str]] = None) -> None:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--metrics", default="",
+                   help="metrics JSONL file (MetricsFile format)")
+    p.add_argument("--events", default="",
+                   help="flight-recorder dump (JSONL) or Chrome trace JSON")
+    args = p.parse_args(argv)
+    print(report(args.metrics or None, args.events or None))
+
+
+if __name__ == "__main__":
+    main()
