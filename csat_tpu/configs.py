@@ -96,6 +96,16 @@ class Config:
     # learn exact zeros, enabling the flash kernel's tile skip.
     sbm_floor: float = 0.01
     noise_mode: str = "shared"
+    # backward implementation for the flex attention core
+    # (csat_tpu/ops/flex_core.py) on the pallas backend:
+    # "auto"/"kernel" — hand-tiled two-pass kernel backward where the mod
+    #             provides one (the SBM adjacency family; STE in-kernel),
+    #             reference backward otherwise (CSE, shared-graph);
+    # "reference" — differentiate through flex_reference everywhere:
+    #             gradients become BIT-identical to the xla backend's (the
+    #             strictest parity mode; costs the XLA memory profile in
+    #             backward). The xla backend always uses reference autodiff.
+    flex_bwd: str = "auto"
     # sequence-parallel attention implementation on a `seq`-sharded mesh:
     # "allgather" — XLA's automatic collectives gather full K/V per device;
     # "ring"      — ring attention (csat_tpu/parallel/ring.py): K/V blocks
@@ -391,15 +401,16 @@ class Config:
             # a valid config here (ADVICE r5)
             seq_sharded = any(
                 name == "seq" and size > 1 for name, size in self.mesh_shape)
-            if self.backend == "pallas" or seq_sharded:
-                # the expected-graph eval takes the plain dense route and
-                # would materialize (B,H,N,N) tensors — defeating exactly
-                # the memory levers those configs exist for (v1 limit)
+            if seq_sharded:
+                # the ring path has no expected-adjacency block exchange;
+                # a seq-sharded mesh would fall to the dense route and
+                # materialize (B,H,N,N) tensors — defeating the memory
+                # lever that config exists for. (backend='pallas' is fine
+                # since PR 8: expected adjacency is a first-class flex mod,
+                # csat_tpu/ops/mods.py:SBMExpectedSpec.)
                 raise ValueError(
-                    "eval_graph='expected' runs the dense attention path; "
-                    "it composes with backend='xla' on an unsharded seq "
-                    "axis only (pallas/ring configs keep eval_graph="
-                    "'sample')"
+                    "eval_graph='expected' does not compose with a sharded "
+                    "'seq' mesh axis (ring configs keep eval_graph='sample')"
                 )
         assert self.serve_slots >= 1, self.serve_slots
         assert self.serve_kv_layout in ("paged", "rect"), self.serve_kv_layout
@@ -441,6 +452,7 @@ class Config:
                         "the seq shard count"
                     )
         assert self.noise_mode in ("shared", "counter"), self.noise_mode
+        assert self.flex_bwd in ("auto", "kernel", "reference"), self.flex_bwd
         assert self.seq_impl in ("allgather", "ring"), self.seq_impl
         if (self.seq_impl == "ring" and self.noise_mode != "counter"
                 and not self.full_att):

@@ -15,17 +15,15 @@ CSE_layer) and ``module/disentangled_attn.py``:
   masked (ref ``disentangled_attn.py:44-65``; SURVEY §8.3);
 * pre-norm sublayers with FFN, final LayerNorm (ref ``CSE_layer``).
 
-The gathers are ``jnp.take_along_axis`` under XLA;
-``backend="pallas"`` routes score assembly + softmax through the fused
-Pallas kernel in ``csat_tpu/ops/cse_pallas.py``.
+The score assembly is the ``cse`` flex mod (``csat_tpu/ops/mods.py``):
+``backend`` selects the blocked kernel or the XLA reference evaluation of
+the *same* mod through ``csat_tpu/ops/flex_core.py``.
 """
 
 from __future__ import annotations
 
-import math
 from typing import Any
 
-import jax
 import jax.numpy as jnp
 from flax import linen as nn
 
@@ -35,31 +33,10 @@ from csat_tpu.models.components import (
     XAVIER,
     FeedForward,
     dense,
-    masked_softmax,
     merge_heads,
 )
 
 Dtype = Any
-
-
-def disentangled_scores(
-    q: jnp.ndarray,  # (B, H, N, dk)
-    k: jnp.ndarray,  # (B, H, N, dk)
-    lq: jnp.ndarray,  # (H, R, dk) — per-head projected relative table (queries)
-    lk: jnp.ndarray,  # (H, R, dk) — per-head projected relative table (keys)
-    rel: jnp.ndarray,  # (B, H, N, N) int32 — offset distances in [0, R)
-) -> jnp.ndarray:
-    """c2c + p2c + c2p score assembly (ref ``disentangled_attn.py:44-61``)."""
-    dk = q.shape[-1]
-    scale = math.sqrt(dk * 3)
-    c2c = jnp.einsum("bhnd,bhmd->bhnm", q, k) / scale
-    # p2c[b,h,i,j] = (lq · k_j)[rel[b,h,j,i]] — gather over the R axis
-    p2c_full = jnp.einsum("hrd,bhmd->bhrm", lq, k)  # (B, H, R, N)
-    p2c = jnp.take_along_axis(p2c_full, jnp.swapaxes(rel, -1, -2), axis=2) / scale
-    # c2p[b,h,i,j] = (q_i · lk)[rel[b,h,i,j]]
-    c2p_full = jnp.einsum("bhnd,hrd->bhnr", q, lk)  # (B, H, N, R)
-    c2p = jnp.take_along_axis(c2p_full, rel, axis=3) / scale
-    return c2c + p2c + c2p
 
 
 class DisentangledAttn(nn.Module):
@@ -105,18 +82,21 @@ class DisentangledAttn(nn.Module):
         rel_q = jnp.concatenate([lq, tq], axis=0).astype(jnp.float32)  # (8, R, dk)
         rel_k = jnp.concatenate([lk, tk], axis=0).astype(jnp.float32)
 
-        if cfg.backend == "pallas":
-            from csat_tpu.ops.cse_pallas import disentangled_attention_pallas
+        from csat_tpu.ops.flex_core import (
+            flex_attention,
+            flex_reference,
+            select_impl,
+        )
+        from csat_tpu.ops.mods import cse_mod
 
-            # rel/mask carry only the two distinct L/T planes; the kernel's
-            # index map fans each plane out to its 4 pseudo-heads.
-            out = disentangled_attention_pallas(q, k, v, rel_q, rel_k, rel, mask)
+        # rel/mask carry only the two distinct L/T planes; the mod fans
+        # each plane out to its 4 pseudo-heads at the point of use (kernel
+        # index maps / reference repeat).
+        spec, aux = cse_mod(rel_q, rel_k, rel, mask)
+        if select_impl(cfg.backend) == "kernel":
+            out, _ = flex_attention(q, k, v, spec, aux, bwd=cfg.flex_bwd)
         else:
-            rel8 = jnp.repeat(rel, half, axis=1)
-            mask8 = jnp.repeat(mask, half, axis=1)
-            scores = disentangled_scores(q, k, rel_q, rel_k, rel8)
-            attn = masked_softmax(scores, mask8)
-            out = jnp.einsum("bhnm,bhmd->bhnd", attn, v)
+            out, _ = flex_reference(q, k, v, spec, aux)
         if cfg.cse_empty_rows == "zero":
             # flagged quirk-fix (configs.Config.cse_empty_rows): a row with
             # no related pair — every column masked — softmaxes to uniform

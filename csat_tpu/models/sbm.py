@@ -24,8 +24,14 @@ Capability parity with ``/root/reference/module/sbm_model.py`` and
   (quirk, ref ``sbm_model.py:68``, SURVEY §8.11) and projected
   ``sbm_enc_dim → hidden_size``.
 
-The ``backend="pallas"`` switch routes the attention inner loop through the
-fused Pallas TPU kernel in ``csat_tpu/ops/sbm_pallas.py``.
+Both backends route the attention inner loop through the flex core
+(``csat_tpu/ops/flex_core.py``): the SBM variants are expressed as mods
+(``csat_tpu/ops/mods.py`` — sampled counter-stream, materialized shared
+graph, expected adjacency) and ``cfg.backend`` only selects *which
+evaluation* of those mods runs — the blocked Pallas kernel or the XLA
+reference generated from the same definitions.  The two paths see the
+identical Bernoulli and dropout streams, so xla-vs-pallas training curves
+are comparable by construction.
 """
 
 from __future__ import annotations
@@ -78,7 +84,14 @@ class ClusterProj(nn.Module):
 
 
 class SBMAttention(nn.Module):
-    """Sampled block-sparse attention core. Returns (out, sparsity, graph, attn)."""
+    """Sampled block-sparse attention core. Returns (out, sparsity, graph, attn).
+
+    The three graph semantics — counter-stream sampled, shared-noise
+    sampled, expected adjacency — are flex mods; ``backend`` picks the
+    evaluation (blocked kernel vs XLA reference of the same mods) through
+    the single :func:`csat_tpu.ops.flex_core.select_impl` dispatch.  The
+    aux-collecting analysis path always evaluates the reference (it must
+    materialize the graph and attention map anyway)."""
 
     num_heads: int
     head_dim: int
@@ -89,6 +102,7 @@ class SBMAttention(nn.Module):
     seq_impl: str = "allgather"  # "allgather" | "ring" (see configs.Config)
     floor: float = 0.01  # Bernoulli clamp floor (cfg.sbm_floor; 0.0 = quirk-fix)
     eval_graph: str = "sample"  # "sample" | "expected" (see configs.Config)
+    flex_bwd: str = "auto"  # "auto" | "kernel" | "reference" (configs.Config)
 
     @nn.compact
     def __call__(
@@ -100,6 +114,18 @@ class SBMAttention(nn.Module):
         deterministic: bool = True,
         need_aux: bool = False,
     ) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+        from csat_tpu.ops.flex_core import (
+            flex_attention,
+            flex_reference,
+            num_blocks,
+            select_impl,
+        )
+        from csat_tpu.ops.mods import (
+            sbm_expected_mod,
+            sbm_graph_mod,
+            sbm_sampled_mod,
+        )
+
         b, h, n, dh = q.shape
         kk = self.num_clusters
         clusters = self.param(
@@ -121,8 +147,6 @@ class SBMAttention(nn.Module):
         # decode output — and therefore val/test BLEU — stops being a
         # random variable in the decode key (measured sampling noise:
         # σ≈0.16-0.30 corpus BLEU on the 200-sample stdlib test split).
-        # Takes the plain dense route below (Config.validate forbids the
-        # combination with the pallas/ring memory-lever configs).
         expected = deterministic and self.eval_graph == "expected"
 
         def draw_seed(name: str):
@@ -132,12 +156,10 @@ class SBMAttention(nn.Module):
             return jnp.sum(graph_sums, axis=0) / (b * n * n)
 
         if self.noise_mode == "counter" and not expected:
-            # counter-based hash stream (csat_tpu/ops/hashrng.py): the pallas
-            # path generates it in-kernel tile-by-tile — no (B,H,N,N) noise
-            # tensor in HBM; the XLA path materializes the identical field so
-            # the two backends sample the identical graph
-            from csat_tpu.ops.hashrng import noise_stride
-
+            # counter-based hash stream (csat_tpu/ops/hashrng.py): the kernel
+            # generates it in-kernel tile-by-tile — no (B,H,N,N) noise
+            # tensor in HBM; the reference materializes the identical field
+            # so the two backends sample the identical graph
             sample_seed = draw_seed("sample")
             if self.seq_impl == "ring" and not need_aux:
                 from csat_tpu.parallel.ring import ring_active, ring_sbm_attention
@@ -152,57 +174,41 @@ class SBMAttention(nn.Module):
                         floor=self.floor,
                     )
                     return out, head_sparsity(graph_sums), None, None
-            if self.backend == "pallas" and not need_aux:
-                from csat_tpu.ops.sbm_flash_pallas import sbm_attention_flash
-
-                out, graph_sums = sbm_attention_flash(
-                    q, k, v, q_hat, k_hat, s, key_pad, sample_seed,
-                    rate, draw_seed("dropout") if use_dropout else None,
-                    floor=self.floor,
-                )
-                return out, head_sparsity(graph_sums), None, None
-            from csat_tpu.ops.hashrng import uniform_field
-
-            noise = uniform_field(sample_seed, b, h, n, n, noise_stride(n))
+            spec, aux = sbm_sampled_mod(
+                q_hat, k_hat, s, key_pad, sample_seed, self.floor)
         elif expected:
-            noise = None  # the Bernoulli mean needs no draws
+            spec, aux = sbm_expected_mod(q_hat, k_hat, s, key_pad, self.floor)
         else:
+            # shared jax.random noise, sampled through the STE outside the
+            # core; the materialized graph rides in as mod aux and its
+            # cotangent flows back out through the reference backward
+            exp_a = jnp.einsum("bhnk,hkj,bhmj->bhnm", q_hat, s, k_hat)
             noise = bernoulli_noise(self.make_rng("sample"), (b, h, n, n))
-        if self.backend == "pallas" and not need_aux and not expected:
-            # fully-fused path: expA, the sampled graph, the scores and the
-            # attention map never reach HBM (csat_tpu/ops/sbm_fused_pallas.py)
-            from csat_tpu.ops.sbm_fused_pallas import sbm_attention_fused_pallas
+            spec, aux = sbm_graph_mod(
+                sample_graph(exp_a, noise, self.floor), key_pad)
 
-            out, graph_sums, _ = sbm_attention_fused_pallas(
-                q, k, v, q_hat, k_hat, s, noise, key_pad,
-                rate, draw_seed("dropout") if use_dropout else None,
-                floor=self.floor,
-            )
-            return out, head_sparsity(graph_sums), None, None
-
-        exp_a = jnp.einsum("bhnk,hkj,bhmj->bhnm", q_hat, s, k_hat)
-        graph = (
-            jnp.clip(exp_a, self.floor, 0.99) if expected
-            else sample_graph(exp_a, noise, self.floor)
-        )
-        mask = key_pad[:, None, None, :].astype(bool)
-        if self.backend == "pallas" and not expected:
-            from csat_tpu.ops.sbm_pallas import sbm_attention_pallas
-
-            if use_dropout:
-                out, attn = sbm_attention_pallas(
-                    q, k, v, graph, key_pad, rate, draw_seed("dropout")
+        drop_seed = draw_seed("dropout") if use_dropout else None
+        if need_aux:
+            out, extras = flex_reference(
+                q, k, v, spec, aux, rate, drop_seed, return_aux=True)
+            graph, attn = extras["graph"], extras["attn"]
+        else:
+            graph = attn = None
+            if select_impl(self.backend) == "kernel":
+                out, extras = flex_attention(
+                    q, k, v, spec, aux, rate, drop_seed, bwd=self.flex_bwd)
+                # realized block-skip share — the bench's pallas evidence
+                self.sow(
+                    "intermediates", "block_skip_frac",
+                    jnp.sum(extras["skipped_blocks"]) / (b * h * num_blocks(n)),
                 )
             else:
-                out, attn = sbm_attention_pallas(q, k, v, graph, key_pad)
-        else:
-            dot = jnp.einsum("bhnd,bhmd->bhnm", q, k) / math.sqrt(dh)
-            dot = jnp.where(mask, -jnp.inf, dot)
-            attn = l1_normalize(jax.nn.softmax(dot, axis=-1) * graph)
-            attn_d = nn.Dropout(self.attention_dropout)(attn, deterministic=deterministic)
-            out = jnp.einsum("bhnm,bhmd->bhnd", attn_d, v)
-        sparsity = jnp.sum(graph, axis=(0, 2, 3)) / (b * n * n)  # (H,)
-        return out, sparsity, graph, attn
+                out, extras = flex_reference(q, k, v, spec, aux, rate, drop_seed)
+            self.sow(
+                "intermediates", "mask_density",
+                jnp.sum(extras["graph_sum"]) / (b * h * n * n),
+            )
+        return out, head_sparsity(extras["graph_sum"]), graph, attn
 
 
 class FullAttention(nn.Module):
@@ -267,6 +273,7 @@ class SBMBlock(nn.Module):
                 seq_impl=cfg.seq_impl,
                 floor=cfg.sbm_floor,
                 eval_graph=cfg.eval_graph,
+                flex_bwd=cfg.flex_bwd,
             )(q, k, v, key_pad, deterministic, need_aux)
         attn_out = dense(d, self.dtype, name="wo")(merge_heads(attn_out).astype(self.dtype))
         x = x + nn.Dropout(cfg.dropout)(attn_out, deterministic=deterministic)

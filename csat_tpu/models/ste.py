@@ -36,7 +36,7 @@ def sample_graph(
     ``floor`` defaults to the reference's 0.01 clamp; ``cfg.sbm_floor=0.0``
     is the flagged quirk-fix that lets the model drive edge probabilities to
     exactly zero (the precondition for data-dependent block skipping in the
-    flash kernel — ``ops/sbm_flash_pallas.py:24-32``).
+    flex core — ``ops/flex_core.py``).
     """
     p = jnp.clip(exp_a, floor, 0.99)
     return (noise < p).astype(exp_a.dtype)

@@ -1,23 +1,41 @@
-"""Pallas TPU kernels for the two attention hot paths.
+"""One attention-kernel programming model for the two hot paths.
 
 The reference computes both attentions as chains of stock torch ops that
 materialize several (B, H, N, N) intermediates in device memory
 (``/root/reference/module/sbm_attn.py:32-66``,
-``module/disentangled_attn.py:44-65``). On TPU the bottleneck is HBM
-bandwidth, so these kernels fuse the whole score → mask → softmax →
-(graph ⊙ / relative-bias) → renormalize → ⊙V chain into a single VMEM-resident
-pass per (batch, head) tile, with hand-written backward kernels that
-recompute the cheap intermediates instead of storing them.
+``module/disentangled_attn.py:44-65``).  On TPU the bottleneck is HBM
+bandwidth; instead of one hand-written kernel per attention variant (the
+r01–r07 state: four modules, ~1.4k LoC, drifting semantics) this package
+carries exactly one blocked kernel and expresses every variant as a *mod*:
 
-Kernels:
-
-* :mod:`csat_tpu.ops.sbm_pallas` — SBM sampled-sparse attention
-  (masked softmax ⊙ sampled graph, L1 renorm, in-kernel dropout).
-* :mod:`csat_tpu.ops.cse_pallas` — disentangled relative attention for the
-  CSE positional-encoding stack.
+* :mod:`csat_tpu.ops.flex_core` — the FlexAttention-style core: a 128×128
+  blocked forward (+ ``custom_vjp``) whose inner loop is parameterized by
+  ``tile_weight`` / ``tile_score`` callables traced in at compile time,
+  SBM-cluster-driven block skipping with a realized-skip counter, and
+  :func:`~csat_tpu.ops.flex_core.flex_reference` — the XLA path generated
+  from the *same* mod definitions, which is both the ``backend="xla"``
+  model path and the parity source of truth.
+* :mod:`csat_tpu.ops.mods` — the registered mods: SBM sampled-Bernoulli
+  (counter hash stream, in-kernel), SBM shared-noise materialized graph,
+  SBM expected adjacency, and the CSE disentangled L/T relative bias.
+* :mod:`csat_tpu.ops.hashrng` — the counter-based uniform stream both
+  evaluations (and the ring path) regenerate bit-identically.
 
 All kernels run in interpret mode off-TPU so the CPU test suite exercises
-them bit-for-bit.
+them bit-for-bit (tests/test_ops.py: the per-mod parity gate).
 """
 
-from csat_tpu.ops.sbm_pallas import sbm_attention_pallas  # noqa: F401
+from csat_tpu.ops.flex_core import (  # noqa: F401
+    flex_attention,
+    flex_reference,
+    num_blocks,
+    select_impl,
+)
+from csat_tpu.ops.mods import (  # noqa: F401
+    MOD_BUILDERS,
+    MOD_NAMES,
+    cse_mod,
+    sbm_expected_mod,
+    sbm_graph_mod,
+    sbm_sampled_mod,
+)

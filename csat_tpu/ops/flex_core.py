@@ -1,0 +1,676 @@
+"""One blocked attention core, parameterized by composable mods.
+
+This replaces the four hand-rolled kernel modules the repo carried through
+r01–r07 (``sbm_pallas`` / ``sbm_fused_pallas`` / ``sbm_flash_pallas`` /
+``cse_pallas``, ~1.4k LoC) with a single FlexAttention-style kernel
+(PAPERS.md: Flex Attention, arXiv 2412.05496): the inner loop is a plain
+blocked attention whose *semantics* come from a mod — a small spec object
+whose ``tile_weight`` / ``tile_score`` callables are traced into the kernel
+at compile time.  The same mod also defines ``full_weight`` / ``full_score``
+over whole arrays, from which :func:`flex_reference` builds the XLA
+composition — so the kernel and the reference path are two evaluations of
+the *same* definitions, not two implementations that drift apart.
+
+Everything is expressed in the **weighted-softmax-cancelled** form.  All of
+the repo's attentions fit one identity: for any non-negative weight field
+``w`` (a sampled 0/1 graph, a clipped expected adjacency, a padding gate)
+
+    L1renorm(softmax(s) ⊙ w)  ==  (w ⊙ e^s) / Σ_k w_k e^{s_k}
+
+because the softmax normalizer cancels under the L1 renorm.  The kernel
+therefore runs one streaming chain — scores → ``score_mod`` → weight →
+masked max/exp/sum → ⊙V — and a mod is just:
+
+* ``tile_weight``: the multiplicative weight for one 128×128 tile.  SBM
+  sampled-Bernoulli generates it in-kernel from the counter hash stream
+  (:mod:`csat_tpu.ops.hashrng`); SBM expected-adjacency computes
+  ``clip(Q̂SK̂ᵀ, floor, .99)`` per tile; the shared-noise mode reads a
+  materialized graph block; CSE uses the real-extent gate.
+* ``tile_score``: an additive score modification.  CSE adds the
+  disentangled L/T relative biases (lane-axis gathers) and the -1e9
+  distance-mask fill; the SBM family is identity.
+
+**Block skipping** (FSA-style, arXiv 2508.18224): a (q-tile, k-tile) pair
+whose weight block is entirely zero contributes nothing to any row's
+normalizer, so the kernel skips its score/value matmuls under ``@pl.when``
+and counts the skip — the realized skip fraction is returned in ``extras``
+(``skipped_blocks`` per (batch, head)) and surfaced by the bench.  With the
+SBM cluster structure and ``sbm_floor=0.0`` whole off-cluster blocks die;
+at the reference floor the skips come from ragged-batch padding.
+
+**Numerics / parity contract.** The kernel accumulates the score row for
+one q-tile in VMEM scratch and runs the softmax reduction over the full
+(lane-padded) key axis in one shot — the same reduction order as the XLA
+reference — instead of streaming (m, l) statistics.  Forward outputs are
+bit-comparable to :func:`flex_reference` at f32 (pinned by
+tests/test_ops.py); the dropout keep-mask and the Bernoulli stream come
+from the same counter hash on both paths, so the two backends see
+*identical* randomness.  This is what closed the bench's frozen
+pallas-vs-xla loss gap (9.5702 vs 8.9354, BENCH_r01–r05): the gap was never
+kernel math — the old variants compared different batch sizes, step counts
+and RNG streams (jax.random ``nn.Dropout`` vs hash dropout, shared vs
+counter noise).  See tests/test_ops.py::test_fit_parity_kernel_vs_reference
+for the regression gate.
+
+Backward: ``custom_vjp``.  The SBM adjacency family (sampled + expected)
+has a hand-tiled two-pass kernel backward (q-side then k-side accumulation,
+ported from the flash kernel) implementing the straight-through estimator
+exactly; every other mod — and ``flex_bwd="reference"`` — differentiates
+through :func:`flex_reference` (the same trade the old CSE kernel made:
+gather cotangents are scatter-adds, which XLA schedules well).
+
+Off-TPU every kernel runs in Pallas interpret mode, so the CPU suite
+exercises the exact kernel code path.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+from typing import Any, Dict, NamedTuple, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.dtypes import float0
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from csat_tpu.ops.hashrng import TILE, bits_to_uniform, hash_bits, round_up
+
+__all__ = [
+    "TILE", "KPAD", "NEG", "Geometry", "TileCtx", "geometry", "num_blocks",
+    "select_impl", "flex_attention", "flex_reference",
+    "reference_block_skip", "keep_field",
+]
+
+KPAD = 128  # cluster/table axis padded to one lane tile
+NEG = -1e30
+BIG = 1e30
+
+
+def _interpret() -> bool:
+    return jax.default_backend() != "tpu"
+
+
+def select_impl(backend: str) -> str:
+    """Map a config backend to a flex implementation.  This is the single
+    dispatch point — ``models/`` never compares against backend names
+    (pinned by the static check in tests/test_ops.py)."""
+    return "kernel" if backend == "pallas" else "reference"
+
+
+@dataclasses.dataclass(frozen=True)
+class Geometry:
+    """Static shape facts shared by the kernel, the mods and the reference."""
+
+    b: int
+    h: int
+    n: int
+    dh: int
+    n_pad: int
+
+    @property
+    def nt(self) -> int:  # tiles per node axis
+        return self.n_pad // TILE
+
+
+def geometry(q: jnp.ndarray) -> Geometry:
+    b, h, n, dh = q.shape
+    return Geometry(b=b, h=h, n=n, dh=dh, n_pad=round_up(n, TILE))
+
+
+def num_blocks(n: int) -> int:
+    """(q-tile, k-tile) pairs per (batch, head) — the denominator for the
+    realized block-skip fraction."""
+    return (round_up(n, TILE) // TILE) ** 2
+
+
+class TileCtx(NamedTuple):
+    """Per-tile context handed to a mod's tile callables inside the kernel."""
+
+    b: Any          # traced grid indices
+    h: Any
+    iq: Any
+    ik: Any
+    bh: Any         # flattened batch·head index (hash stream lane)
+    rows: Any       # (TILE, 1) int32 — global q indices of this tile
+    cols: Any       # (1, TILE) int32 — global k indices
+    q: Any          # (TILE, dh) f32 — this tile's queries
+    k: Any          # (TILE, dh) f32 — this tile's keys
+    geom: Geometry
+
+
+# ---------------------------------------------------------------------------
+# shared math — the kernel and flex_reference call the SAME functions
+# ---------------------------------------------------------------------------
+
+def _finalize(s: jnp.ndarray, w: jnp.ndarray):
+    """Weighted-softmax-cancelled normalization over the last axis.
+
+    ``attn_ij = w_ij e^{s_ij} / Σ_k w_ik e^{s_ik}``; rows with no live
+    entry (all ``w = 0``) come out exactly zero.  Shared verbatim between
+    the kernel's per-q-tile finalize and the full-array reference — the
+    parity contract depends on both sides running these ops in this order.
+    Returns ``(attn, lse, ratio)``: ``lse`` is the kernel-backward
+    residual, ``ratio = e^{s-lse}`` the d_w factor — unused outputs are
+    DCE'd per call site.
+
+    The exp is guarded on its INPUT (``s_safe``), not just its output: on a
+    fully-dead row ``m`` is -1e30 and an output-only ``where`` would still
+    evaluate ``exp(s + 1e30) = inf`` on the untaken branch, whose vjp is
+    ``0 · inf = NaN`` — under autodiff that NaN'd every gradient of a batch
+    containing one short sample (all-dead rows are routine at skewed
+    lengths) and the train step's non-finite guard silently skipped every
+    update.  Caught by the bench's paired-fit parity gate on its first run.
+    """
+    live_e = w > 0
+    m = jnp.max(jnp.where(live_e, s, NEG), axis=-1, keepdims=True)
+    s_safe = jnp.where(live_e, s, m)  # dead entries → exp(0)·w=0
+    eexp = jnp.exp(s_safe - m)
+    e = eexp * w
+    l = jnp.sum(e, axis=-1, keepdims=True)
+    live = l > 0
+    l_safe = jnp.where(live, l, 1.0)
+    attn = e / l_safe
+    lse = jnp.where(live, m + jnp.log(l_safe), NEG)
+    return attn, lse, eexp / l_safe
+
+
+@jax.custom_vjp
+def _weighted_softmax(s: jnp.ndarray, w: jnp.ndarray) -> jnp.ndarray:
+    """``_finalize`` with the hand-derived backward — the reference path's
+    equivalent of ``jax.nn.softmax``'s custom JVP.  Differentiating the
+    raw where/exp/sum graph costs ~1.5x the legacy composition's step time
+    on the bench box (measured: xla:f32 headline 3.2s → 4.7s/step); the
+    closed forms ``d_s = attn ⊙ t`` and ``d_w = ratio ⊙ t`` with
+    ``t = g − Σ attn·g`` restore it.  Note d_w at a weight-dead entry uses
+    the input-guarded ``ratio`` (exp(0)/l) — identical to autodiff of the
+    guarded primal, and always killed downstream by the STE/clip/pad gates
+    that own those entries."""
+    attn, _, _ = _finalize(s, w)
+    return attn
+
+
+def _ws_fwd(s, w):
+    attn, _, ratio = _finalize(s, w)
+    return attn, (attn, ratio, w)
+
+
+def _ws_bwd(res, g):
+    attn, ratio, w = res
+    t = g - jnp.sum(attn * g, axis=-1, keepdims=True)
+    d_w = ratio * t
+    if d_w.shape != w.shape:  # w may ride in broadcastable (CSE real gate)
+        axes = tuple(i for i, (a, b) in enumerate(zip(d_w.shape, w.shape))
+                     if b == 1 and a != 1)
+        d_w = jnp.sum(d_w, axis=axes, keepdims=True)
+    return attn * t, d_w
+
+
+_weighted_softmax.defvjp(_ws_fwd, _ws_bwd)
+
+
+def keep_field(dseed, bh, rows, cols, stride: int, rate: float):
+    """Dropout keep/(1-rate) field from the counter hash stream — one
+    definition for the kernel tiles, the reference full field, and the ring
+    path's convention.  Identical bits on both backends by construction."""
+    u = bits_to_uniform(hash_bits(dseed, bh, rows, cols, stride))
+    return jnp.where(u >= rate, 1.0 / (1.0 - rate), 0.0)
+
+
+def _pad_nodes(x: jnp.ndarray, n_pad: int) -> jnp.ndarray:
+    return jnp.pad(x, [(0, 0)] * (x.ndim - 2) + [(0, n_pad - x.shape[-2]), (0, 0)])
+
+
+# ---------------------------------------------------------------------------
+# forward kernel
+# ---------------------------------------------------------------------------
+
+def _fwd_body(*refs, spec, rate: float, geom: Geometry):
+    n_ops = spec.n_kernel_operands
+    dseed_ref, q_ref, k_ref, v_ref = refs[:4]
+    aux = refs[4:4 + n_ops]
+    out_ref, gsum_ref, skip_ref, lse_ref, s_scr, w_scr = refs[4 + n_ops:]
+
+    b, h, iq, ik = (pl.program_id(i) for i in range(4))
+    nk = pl.num_programs(3)
+    bh = b * geom.h + h
+
+    @pl.when((iq == 0) & (ik == 0))
+    def _():
+        gsum_ref[0, 0, 0, 0] = 0.0
+        skip_ref[0, 0, 0, 0] = 0.0
+
+    rows = iq * TILE + jax.lax.broadcasted_iota(jnp.int32, (TILE, 1), 0)
+    cols = ik * TILE + jax.lax.broadcasted_iota(jnp.int32, (1, TILE), 1)
+    ctx = TileCtx(b=b, h=h, iq=iq, ik=ik, bh=bh, rows=rows, cols=cols,
+                  q=q_ref[0, 0], k=k_ref[0, 0], geom=geom)
+
+    w_raw, w_eff = spec.tile_weight(ctx, aux)
+    gsum_ref[0, 0, 0, 0] += jnp.sum(w_raw)
+    live = jnp.sum(w_eff) > 0
+    # realized block-skip counter: increments exactly when @pl.when below
+    # skips this tile's score/value matmuls
+    skip_ref[0, 0, 0, 0] += jnp.where(live, 0.0, 1.0)
+
+    @pl.when(live)
+    def _():
+        s = jnp.dot(ctx.q, ctx.k.T, preferred_element_type=jnp.float32)
+        s = s * spec.scale(geom.dh)
+        s = spec.tile_score(ctx, s, aux)
+        s_scr[:, pl.ds(ik * TILE, TILE)] = s
+        w_scr[:, pl.ds(ik * TILE, TILE)] = jnp.broadcast_to(w_eff, (TILE, TILE))
+
+    @pl.when(jnp.logical_not(live))
+    def _():
+        s_scr[:, pl.ds(ik * TILE, TILE)] = jnp.zeros((TILE, TILE), jnp.float32)
+        w_scr[:, pl.ds(ik * TILE, TILE)] = jnp.zeros((TILE, TILE), jnp.float32)
+
+    @pl.when(ik == nk - 1)
+    def _():
+        # full-row softmax over the scratch-accumulated score row: same
+        # reduction order as the XLA reference (not streaming statistics)
+        attn, lse, _ = _finalize(s_scr[...], w_scr[...])
+        lse_ref[0, 0] = lse
+        if rate > 0.0:
+            krows = iq * TILE + jax.lax.broadcasted_iota(
+                jnp.int32, (TILE, 1), 0)
+            kcols = jax.lax.broadcasted_iota(jnp.int32, (1, geom.n_pad), 1)
+            attn = attn * keep_field(
+                dseed_ref[0], bh, krows, kcols, spec.stride, rate)
+        out_ref[0, 0] = jnp.dot(attn, v_ref[0, 0],
+                                preferred_element_type=jnp.float32)
+
+
+def _qkv_specs(geom: Geometry):
+    """q tiled by iq, k tiled by ik, v resident whole per (b, h)."""
+    dh = geom.dh
+    qspec = lambda g: pl.BlockSpec(
+        (1, 1, TILE, dh), lambda b, h, i, j: (b, h, g(i, j), 0),
+        memory_space=pltpu.VMEM)
+    vfull = pl.BlockSpec(
+        (1, 1, geom.n_pad, dh), lambda b, h, i, j: (b, h, 0, 0),
+        memory_space=pltpu.VMEM)
+    vec = lambda g: pl.BlockSpec(
+        (1, 1, TILE, 1), lambda b, h, i, j: (b, h, g(i, j), 0),
+        memory_space=pltpu.VMEM)
+    scal = pl.BlockSpec(
+        (1, 1, 1, 1), lambda b, h, i, j: (b, h, 0, 0), memory_space=pltpu.SMEM)
+    smem = pl.BlockSpec(memory_space=pltpu.SMEM)
+    return smem, qspec, vfull, vec, scal
+
+
+def _fwd_call(spec, rate, qp, kp, vp, dseed, auxp, geom: Geometry):
+    smem, qspec, vfull, vec, scal = _qkv_specs(geom)
+    qt, kt = (lambda i, j: i), (lambda i, j: j)
+    kernel = functools.partial(_fwd_body, spec=spec, rate=float(rate),
+                               geom=geom)
+    n2 = geom.nt * geom.nt * TILE * TILE
+    out, gsum, skip, lse = pl.pallas_call(
+        kernel,
+        grid=(geom.b, geom.h, geom.nt, geom.nt),
+        in_specs=[smem, qspec(qt), qspec(kt), vfull,
+                  *spec.aux_specs(geom, qt, kt)],
+        out_specs=[qspec(qt), scal, scal, vec(qt)],
+        out_shape=[
+            jax.ShapeDtypeStruct((geom.b, geom.h, geom.n_pad, geom.dh), jnp.float32),
+            jax.ShapeDtypeStruct((geom.b, geom.h, 1, 1), jnp.float32),
+            jax.ShapeDtypeStruct((geom.b, geom.h, 1, 1), jnp.float32),
+            jax.ShapeDtypeStruct((geom.b, geom.h, geom.n_pad, 1), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((TILE, geom.n_pad), jnp.float32),
+            pltpu.VMEM((TILE, geom.n_pad), jnp.float32),
+        ],
+        cost_estimate=pl.CostEstimate(
+            flops=geom.b * geom.h * n2 * (4 * geom.dh + spec.weight_flops + 10),
+            bytes_accessed=geom.b * geom.h * geom.n_pad * (3 * geom.dh + KPAD) * 4,
+            transcendentals=geom.b * geom.h * n2,
+        ),
+        interpret=_interpret(),
+    )(dseed, qp, kp, vp, *auxp)
+    return out, gsum, skip, lse
+
+
+def _kernel_fwd(spec, rate, q, k, v, dseed, aux):
+    geom = geometry(q)
+    qp, kp, vp = (_pad_nodes(x, geom.n_pad) for x in (q, k, v))
+    auxp = spec.pad_aux(aux, geom)
+    out_p, gsum, skip, lse = _fwd_call(spec, rate, qp, kp, vp, dseed, auxp, geom)
+    extras = {
+        "graph_sum": gsum[:, :, 0, 0],
+        "skipped_blocks": skip[:, :, 0, 0],
+    }
+    return out_p[:, :, :geom.n, :], extras, lse
+
+
+# ---------------------------------------------------------------------------
+# backward kernels — SBM adjacency family only (sampled + expected).
+# Two passes ported from the flash kernel: grid (b, h, iq, ik) accumulates
+# the q-side grads (dq, dr) over k tiles, grid (b, h, ik, iq) the k-side
+# (dk, dv, dkh) over q tiles.  Other mods differentiate through
+# flex_reference (see _flex_bwd).
+# ---------------------------------------------------------------------------
+
+def _bwd_tile(spec, ctx, aux, live, a_raw, a_eff, exp_a, v, g_out, lse, dvec,
+              gs, keep):
+    """Shared per-tile backward math.  ``lse``/``dvec`` are (TILE, 1)
+    columns.  Returns (d_exp_a, d_s, attn_d)."""
+    inv = spec.scale(ctx.geom.dh)
+    # the sparsity-regularizer cotangent gs reaches the RAW weight (counted
+    # at padded key columns too); the attention-path term only the
+    # effective one, hence the pad gate
+    gate = spec.tile_pad_gate(ctx, aux)  # (1, TILE): 1.0 on unpadded keys
+
+    def heavy(_):
+        s = jnp.dot(ctx.q, ctx.k.T, preferred_element_type=jnp.float32) * inv
+        finite = lse > -BIG / 2
+        # live entries satisfy s ≤ lse, so the clamp only touches dead
+        # entries (whose e is masked or STE-gated away) — it exists to keep
+        # exp() finite there, where 0 · inf would otherwise poison the tile
+        expo = jnp.minimum(s - jnp.where(finite, lse, 0.0), 80.0)
+        e = jnp.where(finite, jnp.exp(expo), 0.0)
+        attn = e * a_eff
+        d_attn = jnp.dot(g_out, v.T, preferred_element_type=jnp.float32) * keep
+        d_s = attn * (d_attn - dvec)
+        d_a = e * (d_attn - dvec) * gate + gs
+        d_exp_a = spec.tile_dexp(ctx, a_raw, exp_a, d_a)
+        return d_exp_a, d_s, attn * keep
+
+    def cheap(_):
+        z = jnp.zeros((TILE, TILE), jnp.float32)
+        d_a = jnp.broadcast_to(gs, (TILE, TILE))
+        return spec.tile_dexp(ctx, a_raw, exp_a, d_a), z, z
+
+    return jax.lax.cond(live, heavy, cheap, None)
+
+
+def _bwd_q_body(*refs, spec, rate: float, geom: Geometry):
+    n_ops = spec.n_kernel_operands
+    dseed_ref, q_ref, k_ref, v_ref = refs[:4]
+    aux = refs[4:4 + n_ops]
+    lse_ref, dvec_ref, go_ref, gs_ref = refs[4 + n_ops:8 + n_ops]
+    dq_ref, dr_ref, dq_scr, dr_scr = refs[8 + n_ops:]
+
+    b, h, iq, ik = (pl.program_id(i) for i in range(4))
+    nk = pl.num_programs(3)
+    bh = b * geom.h + h
+
+    @pl.when(ik == 0)
+    def _():
+        dq_scr[...] = jnp.zeros_like(dq_scr[...])
+        dr_scr[...] = jnp.zeros_like(dr_scr[...])
+
+    rows = iq * TILE + jax.lax.broadcasted_iota(jnp.int32, (TILE, 1), 0)
+    cols = ik * TILE + jax.lax.broadcasted_iota(jnp.int32, (1, TILE), 1)
+    ctx = TileCtx(b=b, h=h, iq=iq, ik=ik, bh=bh, rows=rows, cols=cols,
+                  q=q_ref[0, 0], k=k_ref[0, 0], geom=geom)
+    a_raw, a_eff, exp_a = spec.tile_weight_parts(ctx, aux)
+    keep = (
+        keep_field(dseed_ref[0], bh, rows, cols, spec.stride, rate)
+        if rate > 0.0 else 1.0
+    )
+    live = jnp.sum(a_eff) > 0
+    d_exp_a, d_s, _ = _bwd_tile(
+        spec, ctx, aux, live, a_raw, a_eff, exp_a, v_ref[0, 0], go_ref[0, 0],
+        lse_ref[0, 0], dvec_ref[0, 0], gs_ref[0, 0, 0, 0], keep,
+    )
+    inv = spec.scale(geom.dh)
+
+    @pl.when(live)
+    def _():
+        dq_scr[...] += jnp.dot(d_s, ctx.k, preferred_element_type=jnp.float32) * inv
+
+    kh_blk = spec.kh_block(ctx, aux)
+    dr_scr[...] += jnp.dot(d_exp_a, kh_blk, preferred_element_type=jnp.float32)
+
+    @pl.when(ik == nk - 1)
+    def _():
+        dq_ref[0, 0] = dq_scr[...]
+        dr_ref[0, 0] = dr_scr[...]
+
+
+def _bwd_k_body(*refs, spec, rate: float, geom: Geometry):
+    n_ops = spec.n_kernel_operands
+    dseed_ref, q_ref, k_ref, v_ref = refs[:4]
+    aux = refs[4:4 + n_ops]
+    lse_ref, dvec_ref, go_ref, gs_ref = refs[4 + n_ops:8 + n_ops]
+    dk_ref, dv_ref, dkh_ref, dk_scr, dv_scr, dkh_scr = refs[8 + n_ops:]
+
+    b, h, ik, iq = (pl.program_id(i) for i in range(4))
+    nq = pl.num_programs(3)
+    bh = b * geom.h + h
+
+    @pl.when(iq == 0)
+    def _():
+        dk_scr[...] = jnp.zeros_like(dk_scr[...])
+        dv_scr[...] = jnp.zeros_like(dv_scr[...])
+        dkh_scr[...] = jnp.zeros_like(dkh_scr[...])
+
+    rows = iq * TILE + jax.lax.broadcasted_iota(jnp.int32, (TILE, 1), 0)
+    cols = ik * TILE + jax.lax.broadcasted_iota(jnp.int32, (1, TILE), 1)
+    ctx = TileCtx(b=b, h=h, iq=iq, ik=ik, bh=bh, rows=rows, cols=cols,
+                  q=q_ref[0, 0], k=k_ref[0, 0], geom=geom)
+    a_raw, a_eff, exp_a = spec.tile_weight_parts(ctx, aux)
+    keep = (
+        keep_field(dseed_ref[0], bh, rows, cols, spec.stride, rate)
+        if rate > 0.0 else 1.0
+    )
+    live = jnp.sum(a_eff) > 0
+    d_exp_a, d_s, attn_d = _bwd_tile(
+        spec, ctx, aux, live, a_raw, a_eff, exp_a, v_ref[0, 0], go_ref[0, 0],
+        lse_ref[0, 0], dvec_ref[0, 0], gs_ref[0, 0, 0, 0], keep,
+    )
+    inv = spec.scale(geom.dh)
+
+    @pl.when(live)
+    def _():
+        dk_scr[...] += jnp.dot(d_s.T, ctx.q, preferred_element_type=jnp.float32) * inv
+        dv_scr[...] += jnp.dot(
+            attn_d.T, go_ref[0, 0], preferred_element_type=jnp.float32)
+
+    r_blk = spec.r_block(ctx, aux)
+    dkh_scr[...] += jnp.dot(d_exp_a.T, r_blk, preferred_element_type=jnp.float32)
+
+    @pl.when(iq == nq - 1)
+    def _():
+        dk_ref[0, 0] = dk_scr[...]
+        dv_ref[0, 0] = dv_scr[...]
+        dkh_ref[0, 0] = dkh_scr[...]
+
+
+def _kernel_bwd_calls(spec, rate, qp, kp, vp, dseed, auxp, lse, dvec, go_p,
+                      gs, geom: Geometry):
+    smem, qspec, vfull, vec, scal = _qkv_specs(geom)
+    del vfull
+    cspec = lambda g: pl.BlockSpec(
+        (1, 1, TILE, KPAD), lambda b, h, i, j: (b, h, g(i, j), 0),
+        memory_space=pltpu.VMEM)
+    qt, kt = (lambda i, j: i), (lambda i, j: j)
+    common = dict(spec=spec, rate=float(rate), geom=geom)
+    n2 = geom.nt * geom.nt * TILE * TILE
+    cost = pl.CostEstimate(
+        flops=geom.b * geom.h * n2 * (10 * geom.dh + 2 * KPAD + 16),
+        bytes_accessed=geom.b * geom.h * geom.n_pad * (6 * geom.dh + 2 * KPAD) * 4,
+        transcendentals=geom.b * geom.h * n2,
+    )
+    dq, dr = pl.pallas_call(
+        functools.partial(_bwd_q_body, **common),
+        grid=(geom.b, geom.h, geom.nt, geom.nt),
+        in_specs=[smem, qspec(qt), qspec(kt), qspec(kt),
+                  *spec.aux_specs(geom, qt, kt),
+                  vec(qt), vec(qt), qspec(qt), scal],
+        out_specs=[qspec(qt), cspec(qt)],
+        out_shape=[
+            jax.ShapeDtypeStruct((geom.b, geom.h, geom.n_pad, geom.dh), jnp.float32),
+            jax.ShapeDtypeStruct((geom.b, geom.h, geom.n_pad, KPAD), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((TILE, geom.dh), jnp.float32),
+            pltpu.VMEM((TILE, KPAD), jnp.float32),
+        ],
+        cost_estimate=cost,
+        interpret=_interpret(),
+    )(dseed, qp, kp, vp, *auxp, lse, dvec, go_p, gs)
+
+    # k-side pass: grid dim 2 is the k tile, dim 3 sweeps q tiles
+    kt2, qt2 = (lambda i, j: i), (lambda i, j: j)
+    dk, dv, dkh = pl.pallas_call(
+        functools.partial(_bwd_k_body, **common),
+        grid=(geom.b, geom.h, geom.nt, geom.nt),
+        in_specs=[smem, qspec(qt2), qspec(kt2), qspec(kt2),
+                  *spec.aux_specs(geom, qt2, kt2),
+                  vec(qt2), vec(qt2), qspec(qt2), scal],
+        out_specs=[qspec(kt2), qspec(kt2), cspec(kt2)],
+        out_shape=[
+            jax.ShapeDtypeStruct((geom.b, geom.h, geom.n_pad, geom.dh), jnp.float32),
+            jax.ShapeDtypeStruct((geom.b, geom.h, geom.n_pad, geom.dh), jnp.float32),
+            jax.ShapeDtypeStruct((geom.b, geom.h, geom.n_pad, KPAD), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((TILE, geom.dh), jnp.float32),
+            pltpu.VMEM((TILE, geom.dh), jnp.float32),
+            pltpu.VMEM((TILE, KPAD), jnp.float32),
+        ],
+        cost_estimate=cost,
+        interpret=_interpret(),
+    )(dseed, qp, kp, vp, *auxp, lse, dvec, go_p, gs)
+    return dq, dr, dk, dv, dkh
+
+
+# ---------------------------------------------------------------------------
+# custom_vjp wiring
+# ---------------------------------------------------------------------------
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(0, 1, 2))
+def _flex(spec, rate, bwd_mode, q, k, v, dseed, aux):
+    out, extras, _ = _kernel_fwd(spec, rate, q, k, v, dseed, aux)
+    return out, extras
+
+
+def _flex_fwd(spec, rate, bwd_mode, q, k, v, dseed, aux):
+    out, extras, lse = _kernel_fwd(spec, rate, q, k, v, dseed, aux)
+    return (out, extras), (q, k, v, dseed, aux, lse, out)
+
+
+def _flex_bwd(spec, rate, bwd_mode, res, cots):
+    q, k, v, dseed, aux, lse, out = res
+    g_out, g_extras = cots
+    if bwd_mode == "kernel":
+        geom = geometry(q)
+        qp, kp, vp = (_pad_nodes(x, geom.n_pad) for x in (q, k, v))
+        auxp = spec.pad_aux(aux, geom)
+        go_p = _pad_nodes(g_out, geom.n_pad)
+        out_p = _pad_nodes(out, geom.n_pad)
+        dvec = jnp.sum(go_p * out_p, axis=-1, keepdims=True)
+        gs = jnp.asarray(g_extras["graph_sum"], jnp.float32)[:, :, None, None]
+        dq, dr, dk, dv, dkh = _kernel_bwd_calls(
+            spec, rate, qp, kp, vp, dseed, auxp, lse, dvec, go_p, gs, geom)
+        n = geom.n
+        d_aux = spec.assemble_aux_grads(
+            aux, dr[:, :, :n, :], dkh[:, :, :n, :])
+        return (dq[:, :, :n, :], dk[:, :, :n, :], dv[:, :, :n, :],
+                np.zeros(dseed.shape, dtype=float0), d_aux)
+
+    def ref(q_, k_, v_, dseed_, aux_):
+        return flex_reference(q_, k_, v_, spec, aux_, dropout_rate=rate,
+                              dropout_seed=dseed_)
+
+    _, pullback = jax.vjp(ref, q, k, v, dseed, aux)
+    return pullback((g_out, g_extras))
+
+
+_flex.defvjp(_flex_fwd, _flex_bwd)
+
+
+def _resolve_bwd(spec, bwd: str) -> str:
+    """``reference`` forces differentiation through :func:`flex_reference`
+    (bit-identical to the XLA backend's gradients); ``kernel``/``auto``
+    prefer the hand-tiled kernel backward where the mod provides one."""
+    if bwd not in ("auto", "kernel", "reference"):
+        raise ValueError(f"unknown flex bwd mode {bwd!r}")
+    if bwd == "reference" or not spec.supports_kernel_bwd:
+        return "reference"
+    return "kernel"
+
+
+def flex_attention(
+    q: jnp.ndarray,  # (B, H, N, dh) f32
+    k: jnp.ndarray,
+    v: jnp.ndarray,
+    spec,
+    aux: Tuple[jnp.ndarray, ...],
+    dropout_rate: float = 0.0,
+    dropout_seed: Optional[jnp.ndarray] = None,
+    bwd: str = "auto",
+) -> Tuple[jnp.ndarray, Dict[str, jnp.ndarray]]:
+    """Blocked-kernel evaluation of a mod.  Returns ``(out, extras)`` with
+    ``extras = {"graph_sum": (B, H), "skipped_blocks": (B, H)}`` —
+    ``graph_sum`` is ΣW per (batch, head) (the sparsity numerator),
+    ``skipped_blocks`` the realized block-skip count out of
+    :func:`num_blocks` tiles."""
+    if dropout_seed is None:
+        dropout_seed = jnp.zeros((1,), jnp.int32)
+    else:
+        dropout_seed = jnp.asarray(dropout_seed, jnp.int32).reshape((1,))
+    mode = _resolve_bwd(spec, bwd)
+    with jax.named_scope(f"flex.{spec.name}"):
+        return _flex(spec, float(dropout_rate), mode, q, k, v, dropout_seed,
+                     tuple(aux))
+
+
+def flex_reference(
+    q: jnp.ndarray,
+    k: jnp.ndarray,
+    v: jnp.ndarray,
+    spec,
+    aux: Tuple[jnp.ndarray, ...],
+    dropout_rate: float = 0.0,
+    dropout_seed: Optional[jnp.ndarray] = None,
+    return_aux: bool = False,
+):
+    """XLA evaluation of the *same* mod definitions — the parity source of
+    truth, and the model's ``backend="xla"`` path.  ``return_aux=True``
+    additionally materializes the weight field and the pre-dropout
+    attention map (the analysis tensors ``collect_aux`` consumes)."""
+    b, h, n, dh = q.shape
+    if dropout_seed is None:
+        dropout_seed = jnp.zeros((1,), jnp.int32)
+    else:
+        dropout_seed = jnp.asarray(dropout_seed, jnp.int32).reshape((1,))
+    with jax.named_scope(f"flex_ref.{spec.name}"):
+        s = jnp.einsum("bhnd,bhmd->bhnm", q, k) * spec.scale(dh)
+        w_raw, w_eff = spec.full_weight(q, k, aux)
+        s = spec.full_score(s, q, k, aux)
+        attn = _weighted_softmax(s, w_eff)
+        gsum = jnp.sum(jnp.broadcast_to(w_raw, s.shape), axis=(2, 3))
+        attn_d = attn
+        if dropout_rate > 0.0:
+            rows = jax.lax.broadcasted_iota(jnp.int32, (1, 1, n, 1), 2)
+            cols = jax.lax.broadcasted_iota(jnp.int32, (1, 1, 1, n), 3)
+            bh = (jax.lax.broadcasted_iota(jnp.uint32, (b, h, 1, 1), 0)
+                  * jnp.uint32(h)
+                  + jax.lax.broadcasted_iota(jnp.uint32, (b, h, 1, 1), 1))
+            attn_d = attn * keep_field(
+                dropout_seed[0], bh, rows, cols, spec.stride, dropout_rate)
+        out = jnp.einsum("bhnm,bhmd->bhnd", attn_d, v)
+        extras = {
+            "graph_sum": gsum,
+            "skipped_blocks": jnp.zeros((b, h), jnp.float32),
+        }
+        if return_aux:
+            extras["graph"] = jnp.broadcast_to(w_raw, s.shape)
+            extras["attn"] = attn
+        return out, extras
+
+
+def reference_block_skip(spec, aux, geom: Geometry) -> jnp.ndarray:
+    """Predicted dead-(q-tile, k-tile) count per (batch, head), computed in
+    XLA from the mod's full weight field on the kernel's padded geometry —
+    the oracle the realized ``skipped_blocks`` counter must match
+    (tests/test_ops.py) and the bench's density cross-check."""
+    w_eff = spec.full_weight_padded(aux, geom)  # (B, H, n_pad, n_pad)
+    blocks = w_eff.reshape(geom.b, geom.h, geom.nt, TILE, geom.nt, TILE)
+    dead = jnp.all(blocks <= 0, axis=(3, 5))  # (B, H, nt, nt)
+    return jnp.sum(dead.astype(jnp.float32), axis=(2, 3))

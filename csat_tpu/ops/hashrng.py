@@ -12,8 +12,8 @@ stream is a pure function of indices it can be
   can produce the exact same sampled graph for differential tests.
 
 ``pltpu.prng_*`` is deliberately not used: it returns zeros under the CPU
-interpreter, which would break the off-TPU test suite (see
-``csat_tpu/ops/sbm_pallas.py`` for the same decision for dropout).
+interpreter, which would break the off-TPU test suite (the flex core's
+dropout keep-mask makes the same decision, ``csat_tpu/ops/flex_core.py``).
 """
 
 from __future__ import annotations

@@ -1,0 +1,510 @@
+"""Attention mods: the repo's attentions expressed as flex-core specs.
+
+Each mod is a frozen (hashable) dataclass of *static* facts plus a builder
+returning ``(spec, aux)`` where ``aux`` is the tuple of traced arrays the
+mod needs.  One definition serves three evaluations:
+
+* ``tile_weight`` / ``tile_score`` — traced into the blocked Pallas kernel
+  (:func:`csat_tpu.ops.flex_core.flex_attention`), one 128×128 tile at a
+  time;
+* ``full_weight`` / ``full_score`` — whole-array XLA, from which
+  :func:`csat_tpu.ops.flex_core.flex_reference` builds the parity source
+  of truth (and the model's ``backend="xla"`` path);
+* ``full_weight_padded`` — the weight field on the kernel's padded
+  geometry, the oracle for the realized block-skip counter.
+
+Registered mods (``MOD_NAMES`` — the tier-1 parity gate iterates these):
+
+=============  ==============================================================
+mod            semantics
+=============  ==============================================================
+sbm_sampled    sampled-Bernoulli graph from the counter hash stream
+               (``noise_mode="counter"``): ``A = 1{u < clip(Q̂SK̂ᵀ, floor,
+               .99)}`` generated in-kernel, STE gradient, Σ A sparsity.
+               Kernel backward available (the training hot path).
+sbm_graph      an explicitly materialized 0/1 graph (``noise_mode="shared"``
+               — jax.random noise sampled outside through the STE
+               ``sample_graph``); the graph rides in as aux and its
+               cotangent flows back out.
+sbm_expected   the Bernoulli MEAN ``clip(Q̂SK̂ᵀ, floor, .99)`` as a soft
+               weight (``eval_graph="expected"`` deterministic eval) — the
+               path that used to silently fall back to XLA now runs in the
+               same kernel.  Kernel backward available.
+cse            DeBERTa-style disentangled L/T relative bias: ``c2c + p2c +
+               c2p`` with lane-axis gathers of the projected relative
+               tables, -1e9 fill where the raw distance is 0; the two L/T
+               planes fan out to H/2 pseudo-heads each via the kernel index
+               maps (no (B, H, N, N) index tensors in HBM).
+=============  ==============================================================
+
+Adding a mod: subclass nothing — provide the protocol attributes
+(``name``, ``n_kernel_operands``, ``supports_kernel_bwd``, ``stride``,
+``weight_flops``, ``scale``, ``pad_aux``, ``aux_specs``, ``tile_weight``,
+``tile_score``, ``full_weight``, ``full_score``, ``full_weight_padded``)
+as a frozen dataclass plus a builder, and register the builder in
+``MOD_BUILDERS`` so the parity gate picks it up.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from csat_tpu.ops.flex_core import KPAD, TILE, Geometry, TileCtx
+from csat_tpu.ops.hashrng import (
+    bits_to_uniform, hash_bits, noise_stride, round_up, uniform_field)
+
+__all__ = [
+    "SBMSampledSpec", "SBMGraphSpec", "SBMExpectedSpec", "CSESpec",
+    "sbm_sampled_mod", "sbm_graph_mod", "sbm_expected_mod", "cse_mod",
+    "MOD_NAMES", "MOD_BUILDERS", "disentangled_scores",
+]
+
+NEG_CSE = -1e9  # the reference's CSE mask fill (components.NEG_INF)
+LANE = 128      # Mosaic's dynamic-gather unit spans one vreg of lanes
+
+
+def _nn_pad(x: jnp.ndarray, n_pad: int, value=0.0) -> jnp.ndarray:
+    """Pad the trailing two (node, node) axes of a (..., N, N) array."""
+    n = x.shape[-1]
+    return jnp.pad(
+        x, [(0, 0)] * (x.ndim - 2) + [(0, n_pad - x.shape[-2]), (0, n_pad - n)],
+        constant_values=value)
+
+
+def _factor_pad(x: jnp.ndarray, n_pad: int) -> jnp.ndarray:
+    """(B, H, N, K) membership factor → (B, H, n_pad, KPAD)."""
+    b, h, n, kk = x.shape
+    return jnp.pad(x, ((0, 0), (0, 0), (0, n_pad - n), (0, KPAD - kk)))
+
+
+def _pad_mask_pad(key_pad_f: jnp.ndarray, n_pad: int) -> jnp.ndarray:
+    """(B, N) float pad mask → (B, 1, n_pad), padding marked 1.0 (padded)."""
+    n = key_pad_f.shape[-1]
+    return jnp.pad(key_pad_f, ((0, 0), (0, n_pad - n)),
+                   constant_values=1.0)[:, None, :]
+
+
+def _cspec(g):
+    return pl.BlockSpec((1, 1, TILE, KPAD), lambda b, h, i, j: (b, h, g(i, j), 0),
+                        memory_space=pltpu.VMEM)
+
+
+def _padspec(g):
+    return pl.BlockSpec((1, 1, TILE), lambda b, h, i, j: (b, 0, g(i, j)),
+                        memory_space=pltpu.VMEM)
+
+
+def _nnspec(gq, gk):
+    return pl.BlockSpec(
+        (1, 1, TILE, TILE), lambda b, h, i, j: (b, h, gq(i, j), gk(i, j)),
+        memory_space=pltpu.VMEM)
+
+
+_SMEM = pl.BlockSpec(memory_space=pltpu.SMEM)
+
+
+# ---------------------------------------------------------------------------
+# SBM adjacency family
+# ---------------------------------------------------------------------------
+
+class _SBMAdjacencyBase:
+    """Shared plumbing for mods whose weight derives from the factorized
+    cluster adjacency ``expA = R K̂ᵀ`` with ``R = Q̂ S`` precomputed by the
+    builder (so d_R flows to Q̂ and S through plain autodiff outside the
+    kernel).  aux layout: ``(r, k_hat, key_pad_f32[, sample_seed])``."""
+
+    supports_kernel_bwd = True
+    weight_flops = 2 * KPAD
+
+    def scale(self, dh: int) -> float:
+        return 1.0 / math.sqrt(dh)
+
+    @property
+    def stride(self) -> int:
+        return noise_stride(self.n)
+
+    def _aux_specs_common(self, qt, kt):
+        return [_cspec(qt), _cspec(kt), _padspec(kt)]
+
+    def _pad_common(self, aux, geom: Geometry):
+        r, kh, padf = aux[:3]
+        return (_factor_pad(r, geom.n_pad), _factor_pad(kh, geom.n_pad),
+                _pad_mask_pad(padf, geom.n_pad))
+
+    def _tile_exp_a(self, ctx: TileCtx, aux):
+        return jnp.dot(aux[0][0, 0], aux[1][0, 0].T,
+                       preferred_element_type=jnp.float32)
+
+    def _tile_real(self, ctx: TileCtx):
+        return (ctx.rows < self.n) & (ctx.cols < self.n)
+
+    def tile_score(self, ctx: TileCtx, s, aux):
+        return s
+
+    def full_score(self, s, q, k, aux):
+        return s
+
+    def tile_pad_gate(self, ctx: TileCtx, aux):
+        return 1.0 - aux[2][0]  # (1, TILE): 1.0 on unpadded keys
+
+    def kh_block(self, ctx: TileCtx, aux):
+        return aux[1][0, 0]
+
+    def r_block(self, ctx: TileCtx, aux):
+        return aux[0][0, 0]
+
+    def tile_weight(self, ctx: TileCtx, aux):
+        a_raw, a_eff, _ = self.tile_weight_parts(ctx, aux)
+        return a_raw, a_eff
+
+    def _full_exp_a(self, aux):
+        return jnp.einsum("bhnj,bhmj->bhnm", aux[0], aux[1])
+
+
+@dataclasses.dataclass(frozen=True)
+class SBMSampledSpec(_SBMAdjacencyBase):
+    """Sampled-Bernoulli graph from the counter hash stream, in-kernel."""
+
+    n: int
+    heads: int
+    kk: int
+    floor: float
+
+    name = "sbm_sampled"
+    n_kernel_operands = 4  # r, k_hat, pad, sample seed
+
+    def aux_specs(self, geom: Geometry, qt, kt):
+        return self._aux_specs_common(qt, kt) + [_SMEM]
+
+    def pad_aux(self, aux, geom: Geometry):
+        return self._pad_common(aux, geom) + (aux[3],)
+
+    def tile_weight_parts(self, ctx: TileCtx, aux):
+        exp_a = self._tile_exp_a(ctx, aux)
+        u = bits_to_uniform(hash_bits(
+            aux[3][0], ctx.bh, ctx.rows, ctx.cols, self.stride))
+        p = jnp.clip(exp_a, self.floor, 0.99)
+        a_raw = jnp.where((u < p) & self._tile_real(ctx), 1.0, 0.0)
+        return a_raw, a_raw * (1.0 - aux[2][0]), exp_a
+
+    def tile_dexp(self, ctx: TileCtx, a_raw, exp_a, d_a):
+        # straight-through estimator (models/ste.py): hardtanh(A · g)
+        return jnp.clip(a_raw * d_a, -1.0, 1.0)
+
+    def full_weight(self, q, k, aux):
+        from csat_tpu.models.ste import sample_graph  # lazy: package cycle
+
+        r, kh, padf, sseed = aux
+        b, h, n, _ = r.shape
+        noise = uniform_field(sseed[0], b, h, n, n, self.stride)
+        graph = sample_graph(self._full_exp_a(aux), noise, self.floor)
+        return graph, graph * (1.0 - padf)[:, None, None, :]
+
+    def full_weight_padded(self, aux, geom: Geometry):
+        rp, khp, padp, sseed = self.pad_aux(aux, geom)
+        np_ = geom.n_pad
+        noise = uniform_field(sseed[0], geom.b, geom.h, np_, np_, self.stride)
+        exp_a = jnp.einsum("bhnj,bhmj->bhnm", rp, khp)
+        real = ((jax.lax.broadcasted_iota(jnp.int32, (np_, np_), 0) < self.n)
+                & (jax.lax.broadcasted_iota(jnp.int32, (np_, np_), 1) < self.n))
+        a_raw = jnp.where((noise < jnp.clip(exp_a, self.floor, 0.99)) & real,
+                          1.0, 0.0)
+        return a_raw * (1.0 - padp[:, :, None, :])
+
+    def assemble_aux_grads(self, aux, dr, dkh):
+        import numpy as np
+        from jax.dtypes import float0
+
+        r, kh, padf, sseed = aux
+        return (dr[..., :self.kk], dkh[..., :self.kk],
+                jnp.zeros_like(padf), np.zeros(sseed.shape, dtype=float0))
+
+
+@dataclasses.dataclass(frozen=True)
+class SBMExpectedSpec(_SBMAdjacencyBase):
+    """Bernoulli mean ``clip(expA, floor, .99)`` as a soft weight — the
+    deterministic-eval graph, now a first-class kernel citizen."""
+
+    n: int
+    heads: int
+    kk: int
+    floor: float
+
+    name = "sbm_expected"
+    n_kernel_operands = 3  # r, k_hat, pad
+
+    def aux_specs(self, geom: Geometry, qt, kt):
+        return self._aux_specs_common(qt, kt)
+
+    def pad_aux(self, aux, geom: Geometry):
+        return self._pad_common(aux, geom)
+
+    def tile_weight_parts(self, ctx: TileCtx, aux):
+        exp_a = self._tile_exp_a(ctx, aux)
+        real = self._tile_real(ctx).astype(jnp.float32)
+        a_raw = jnp.clip(exp_a, self.floor, 0.99) * real
+        return a_raw, a_raw * (1.0 - aux[2][0]), exp_a
+
+    def tile_dexp(self, ctx: TileCtx, a_raw, exp_a, d_a):
+        # differentiate exactly what the weight computes: vjp of the clip
+        # (with the real-extent gate), so boundary semantics match XLA
+        real = self._tile_real(ctx).astype(jnp.float32)
+        _, pullback = jax.vjp(
+            lambda x: jnp.clip(x, self.floor, 0.99) * real, exp_a)
+        (d,) = pullback(jnp.broadcast_to(d_a, exp_a.shape))
+        return d
+
+    def full_weight(self, q, k, aux):
+        r, kh, padf = aux
+        w_raw = jnp.clip(self._full_exp_a(aux), self.floor, 0.99)
+        return w_raw, w_raw * (1.0 - padf)[:, None, None, :]
+
+    def full_weight_padded(self, aux, geom: Geometry):
+        rp, khp, padp = self.pad_aux(aux, geom)
+        np_ = geom.n_pad
+        exp_a = jnp.einsum("bhnj,bhmj->bhnm", rp, khp)
+        real = ((jax.lax.broadcasted_iota(jnp.int32, (np_, np_), 0) < self.n)
+                & (jax.lax.broadcasted_iota(jnp.int32, (np_, np_), 1) < self.n))
+        w_raw = jnp.clip(exp_a, self.floor, 0.99) * real.astype(jnp.float32)
+        return w_raw * (1.0 - padp[:, :, None, :])
+
+    def assemble_aux_grads(self, aux, dr, dkh):
+        r, kh, padf = aux
+        return (dr[..., :self.kk], dkh[..., :self.kk], jnp.zeros_like(padf))
+
+
+@dataclasses.dataclass(frozen=True)
+class SBMGraphSpec:
+    """Explicitly materialized 0/1 graph (``noise_mode="shared"``): the
+    graph is sampled outside through the STE ``sample_graph`` and rides in
+    as aux; its cotangent flows back out through the reference backward."""
+
+    n: int
+    heads: int
+
+    name = "sbm_graph"
+    n_kernel_operands = 2  # graph, pad
+    supports_kernel_bwd = False
+    weight_flops = 2
+
+    def scale(self, dh: int) -> float:
+        return 1.0 / math.sqrt(dh)
+
+    @property
+    def stride(self) -> int:
+        return noise_stride(self.n)
+
+    def aux_specs(self, geom: Geometry, qt, kt):
+        return [_nnspec(qt, kt), _padspec(kt)]
+
+    def pad_aux(self, aux, geom: Geometry):
+        graph, padf = aux
+        return (_nn_pad(graph, geom.n_pad), _pad_mask_pad(padf, geom.n_pad))
+
+    def tile_weight(self, ctx: TileCtx, aux):
+        g = aux[0][0, 0]
+        return g, g * (1.0 - aux[1][0])
+
+    def tile_score(self, ctx: TileCtx, s, aux):
+        return s
+
+    def full_weight(self, q, k, aux):
+        graph, padf = aux
+        return graph, graph * (1.0 - padf)[:, None, None, :]
+
+    def full_score(self, s, q, k, aux):
+        return s
+
+    def full_weight_padded(self, aux, geom: Geometry):
+        gp, padp = self.pad_aux(aux, geom)
+        return gp * (1.0 - padp[:, :, None, :])
+
+
+# ---------------------------------------------------------------------------
+# CSE disentangled relative bias
+# ---------------------------------------------------------------------------
+
+def _lane_gather(table, idx):
+    """``take_along_axis(table, idx, axis=1)`` under Mosaic's gather limits.
+
+    Mosaic lowers a lane-axis ``dynamic_gather`` only when (a) the source
+    spans a single vreg along the gather dimension and (b) the source and
+    index shapes are identical.  Both the (T, R_pad) table and the (T, T)
+    index field are therefore swept in 128-lane chunks (static unroll):
+    each index chunk rebases its values into each table chunk's window,
+    gathers with clamped local indices, and a range mask selects the table
+    chunk that actually held the index.  All extents are lane-multiples —
+    the caller pads."""
+    chunks = []
+    for jc in range(idx.shape[1] // LANE):
+        idx_j = idx[:, jc * LANE:(jc + 1) * LANE]
+        out_j = jnp.zeros(idx_j.shape, jnp.float32)
+        for c in range(table.shape[1] // LANE):
+            local = idx_j - c * LANE
+            hit = (local >= 0) & (local < LANE)
+            g = jnp.take_along_axis(
+                table[:, c * LANE:(c + 1) * LANE],
+                jnp.clip(local, 0, LANE - 1), axis=1,
+            )
+            out_j = jnp.where(hit, g, out_j)
+        chunks.append(out_j)
+    return jnp.concatenate(chunks, axis=1) if len(chunks) > 1 else chunks[0]
+
+
+def disentangled_scores(q, k, lq, lk, rel, scale_inv=None):
+    """c2c + c2p + p2c score assembly over full arrays (ref
+    ``disentangled_attn.py:44-61``) — the CSE mod's ``full_score`` math,
+    kept importable for probes and differential tests."""
+    dk = q.shape[-1]
+    inv = scale_inv if scale_inv is not None else 1.0 / math.sqrt(dk * 3)
+    s = jnp.einsum("bhnd,bhmd->bhnm", q, k) * inv
+    c2p_full = jnp.einsum("bhnd,hrd->bhnr", q, lk)  # (B, H, N, R)
+    c2p = jnp.take_along_axis(c2p_full, rel, axis=3)
+    p2c_full = jnp.einsum("hrd,bhmd->bhrm", lq, k)  # (B, H, R, N)
+    p2c = jnp.take_along_axis(p2c_full, jnp.swapaxes(rel, -1, -2), axis=2)
+    return s + c2p * inv + p2c * inv
+
+
+@dataclasses.dataclass(frozen=True)
+class CSESpec:
+    """Disentangled L/T relative-position bias.  The two distinct planes of
+    ``rel``/``mask`` (B, 2, N, N) fan out to ``heads/2`` pseudo-heads each
+    through the kernel index maps — the duplicated (B, H, N, N) tensors
+    never exist in HBM on the kernel path."""
+
+    n: int
+    heads: int
+    dk: int
+    r_len: int
+
+    name = "cse"
+    n_kernel_operands = 5  # lq, lk, rel, rel(transposed view), mask
+    supports_kernel_bwd = False
+    weight_flops = 4 * KPAD
+
+    @property
+    def group(self) -> int:
+        return self.heads // 2
+
+    @property
+    def r_pad(self) -> int:
+        return round_up(self.r_len, LANE)
+
+    def scale(self, dh: int) -> float:
+        return 1.0 / math.sqrt(dh * 3)
+
+    @property
+    def stride(self) -> int:
+        return noise_stride(self.n)
+
+    def aux_specs(self, geom: Geometry, qt, kt):
+        group = self.group
+        table = pl.BlockSpec(
+            (1, self.r_pad, self.dk), lambda b, h, i, j: (h, 0, 0),
+            memory_space=pltpu.VMEM)
+        plane = lambda gq, gk: pl.BlockSpec(
+            (1, 1, TILE, TILE),
+            lambda b, h, i, j: (b, h // group, gq(i, j), gk(i, j)),
+            memory_space=pltpu.VMEM)
+        return [table, table, plane(qt, kt), plane(kt, qt), plane(qt, kt)]
+
+    def pad_aux(self, aux, geom: Geometry):
+        lq, lk, rel, mask = aux
+        pad_r = ((0, 0), (0, self.r_pad - self.r_len), (0, 0))
+        lqp = jnp.pad(lq, pad_r)
+        lkp = jnp.pad(lk, pad_r)
+        relp = _nn_pad(rel, geom.n_pad)
+        maskp = _nn_pad(mask, geom.n_pad, value=1.0)
+        return (lqp, lkp, relp, relp, maskp)
+
+    def tile_weight(self, ctx: TileCtx, aux):
+        real = ((ctx.rows < self.n) & (ctx.cols < self.n)).astype(jnp.float32)
+        return real, real
+
+    def tile_score(self, ctx: TileCtx, s, aux):
+        lq, lk = aux[0][0], aux[1][0]
+        rel, rel_t, mask = aux[2][0, 0], aux[3][0, 0], aux[4][0, 0]
+        inv = self.scale(ctx.geom.dh)
+        c2p = _lane_gather(
+            jnp.dot(ctx.q, lk.T, preferred_element_type=jnp.float32), rel)
+        p2c = _lane_gather(
+            jnp.dot(ctx.k, lq.T, preferred_element_type=jnp.float32), rel_t).T
+        s = s + c2p * inv + p2c * inv
+        return jnp.where(mask > 0, NEG_CSE, s)
+
+    def full_weight(self, q, k, aux):
+        w = jnp.ones((1, 1, 1, k.shape[2]), jnp.float32)
+        return w, w
+
+    def full_score(self, s, q, k, aux):
+        lq, lk, rel, mask = aux
+        rel8 = jnp.repeat(rel, self.group, axis=1)
+        mask8 = jnp.repeat(mask, self.group, axis=1)
+        inv = self.scale(q.shape[-1])
+        c2p_full = jnp.einsum("bhnd,hrd->bhnr", q, lk)
+        c2p = jnp.take_along_axis(c2p_full, rel8, axis=3)
+        p2c_full = jnp.einsum("hrd,bhmd->bhrm", lq, k)
+        p2c = jnp.take_along_axis(p2c_full, jnp.swapaxes(rel8, -1, -2), axis=2)
+        s = s + c2p * inv + p2c * inv
+        return jnp.where(mask8 > 0, NEG_CSE, s)
+
+    def full_weight_padded(self, aux, geom: Geometry):
+        np_ = geom.n_pad
+        real = ((jax.lax.broadcasted_iota(jnp.int32, (np_, np_), 0) < self.n)
+                & (jax.lax.broadcasted_iota(jnp.int32, (np_, np_), 1) < self.n))
+        return jnp.broadcast_to(
+            real.astype(jnp.float32), (geom.b, geom.h, np_, np_))
+
+
+# ---------------------------------------------------------------------------
+# builders
+# ---------------------------------------------------------------------------
+
+def sbm_sampled_mod(q_hat, k_hat, s_aff, key_pad, sample_seed,
+                    floor: float = 0.01):
+    """Counter-mode sampled SBM graph.  ``R = Q̂ S`` is precomputed here so
+    the cotangent reaching ``R`` flows to ``Q̂`` and ``S`` through plain
+    autodiff outside the kernel."""
+    b, h, n, kk = q_hat.shape
+    r = jnp.einsum("bhnk,hkj->bhnj", q_hat, s_aff)
+    aux = (r, k_hat, key_pad.astype(jnp.float32),
+           jnp.asarray(sample_seed, jnp.int32).reshape((1,)))
+    return SBMSampledSpec(n=n, heads=h, kk=kk, floor=float(floor)), aux
+
+
+def sbm_expected_mod(q_hat, k_hat, s_aff, key_pad, floor: float = 0.01):
+    b, h, n, kk = q_hat.shape
+    r = jnp.einsum("bhnk,hkj->bhnj", q_hat, s_aff)
+    aux = (r, k_hat, key_pad.astype(jnp.float32))
+    return SBMExpectedSpec(n=n, heads=h, kk=kk, floor=float(floor)), aux
+
+
+def sbm_graph_mod(graph, key_pad):
+    b, h, n, _ = graph.shape
+    aux = (graph, key_pad.astype(jnp.float32))
+    return SBMGraphSpec(n=n, heads=h), aux
+
+
+def cse_mod(rel_q, rel_k, rel, mask):
+    """Disentangled relative bias: ``rel``/``mask`` carry only the two
+    distinct (B, 2, N, N) L/T planes; fan-out happens at the point of use."""
+    h, r_len, dk = rel_q.shape
+    n = rel.shape[-1]
+    aux = (rel_q.astype(jnp.float32), rel_k.astype(jnp.float32),
+           rel.astype(jnp.int32), mask.astype(jnp.float32))
+    return CSESpec(n=n, heads=h, dk=dk, r_len=r_len), aux
+
+
+MOD_NAMES = ("sbm_sampled", "sbm_graph", "sbm_expected", "cse")
+MOD_BUILDERS = {
+    "sbm_sampled": sbm_sampled_mod,
+    "sbm_graph": sbm_graph_mod,
+    "sbm_expected": sbm_expected_mod,
+    "cse": cse_mod,
+}

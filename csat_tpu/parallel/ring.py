@@ -21,7 +21,7 @@ generate any block's noise locally, so the sampled graph is bit-identical
 to the single-device XLA mirror and to the flash Pallas kernel, with no
 (B, H, N, N) tensor and no cross-device RNG state anywhere.
 
-Semantics match ``csat_tpu/ops/sbm_flash_pallas.py`` (same softmax-
+Semantics match the flex core (``csat_tpu/ops/flex_core.py``: same softmax-
 cancellation formulation, same documented dead-row delta vs the reference's
 1e-12 L1-renorm guard; the straight-through estimator enters through
 :func:`csat_tpu.models.ste.sample_graph`'s ``custom_vjp``, so the backward

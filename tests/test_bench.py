@@ -95,6 +95,41 @@ def test_dead_probe_falls_back_to_cpu_specs(bench, monkeypatch, capsys):
     assert "tpu_probe" in out and "timeout" in out["tpu_probe"]
 
 
+def test_pallas_parity_divergence_fails_loudly(bench, monkeypatch, capsys):
+    """ISSUE 8: a pallas record whose f32 loss diverged from the paired xla
+    fit beyond tolerance must mark the WHOLE artifact degraded with an
+    explicit note — never silently publish (the r01–r05 failure mode).
+    The flex-core fields (block_skip_frac, mask density, parity) must
+    survive into all_variants."""
+
+    def fake_child(args, timeout_s, cpu_only=False):
+        if args[0] == "--probe":
+            return None, "timeout after 120s"
+        for spec in args[1].split(","):
+            _emit(bench, {"phase": "start", "spec": spec})
+            rec = _result(spec, 200.0)
+            if spec.startswith("pallas:float32"):
+                rec["block_skip_frac"] = 0.41
+                rec["mask_density_per_layer"] = [0.2, 0.3]
+                rec["parity"] = {
+                    "pallas_f32_loss": 9.5702, "xla_f32_loss": 8.9354,
+                    "abs_gap": 0.6348, "tol": 1e-5, "ok": False}
+                rec["degraded"] = True
+            _emit(bench, rec)
+        _emit(bench, {"phase": "done"})
+        return {"ok": True, "phase": "done"}, None
+
+    monkeypatch.setattr(bench, "_run_child", fake_child)
+    out = _run_main(bench, capsys)
+    assert out["degraded"] is True
+    assert "diverged" in out.get("notes", "")
+    pal = [v for v in out["all_variants"]
+           if v["backend"] == "pallas" and v["dtype"] == "float32"]
+    assert pal and pal[0]["block_skip_frac"] == 0.41
+    assert pal[0]["parity"]["ok"] is False
+    assert pal[0]["mask_density_per_layer"] == [0.2, 0.3]
+
+
 def test_serve_record_paging_fields_survive_embedding(bench, monkeypatch, capsys):
     """A serve-mode child record's paged-KV fields (equal-memory slot
     ratio, page occupancy, prefix-cache hit rate) must survive into the

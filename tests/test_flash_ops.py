@@ -1,10 +1,18 @@
-"""Flash/block-sparse SBM kernel vs the XLA counter-noise mirror.
+"""Counter-mode SBM: the flex kernel vs the legacy XLA mirror.
 
-The counter-mode contract (``csat_tpu/ops/hashrng.py``): the pallas kernel
-generates the Bernoulli stream in-kernel, the XLA path materializes the
+The counter-mode contract (``csat_tpu/ops/hashrng.py``): the kernel
+generates the Bernoulli stream in-kernel, the XLA side materializes the
 identical field — so the two backends sample the *same* graph and differ
-only in summation order. These tests hold forward and gradients together at
-fp32 tolerance, plus the model-level route.
+only in evaluation order.  ``_xla_mirror`` below is deliberately the
+LEGACY composition (``l1_normalize(softmax ⊙ graph)``) rather than
+``flex_reference``: these tests pin that the flex refactor preserved the
+flash kernel's semantics against the pre-refactor formulation (the ring
+path, ``csat_tpu/parallel/ring.py``, still implements it and
+tests/test_ring.py imports the mirror from here).
+
+Block-skip coverage: the ``sbm_floor=0.0`` quirk-fix tests drive whole
+cluster blocks to zero and assert the realized in-kernel skip counter
+fires and matches the XLA occupancy oracle.
 """
 
 import math
@@ -16,9 +24,15 @@ import pytest
 
 from csat_tpu.models.sbm import l1_normalize
 from csat_tpu.models.ste import sample_graph
-from csat_tpu.ops.hashrng import bits_to_uniform, hash_bits, uniform_field
-from csat_tpu.ops.hashrng import round_up
-from csat_tpu.ops.sbm_flash_pallas import TILE, sbm_attention_flash
+from csat_tpu.ops.flex_core import (
+    TILE,
+    flex_attention,
+    geometry,
+    num_blocks,
+    reference_block_skip,
+)
+from csat_tpu.ops.hashrng import bits_to_uniform, hash_bits, round_up, uniform_field
+from csat_tpu.ops.mods import sbm_sampled_mod
 
 
 def _inputs(b=2, h=2, n=150, dh=32, kk=5, seed=0):
@@ -36,7 +50,8 @@ def _inputs(b=2, h=2, n=150, dh=32, kk=5, seed=0):
 
 def _xla_mirror(q, k, v, q_hat, k_hat, s_aff, pad, sample_seed,
                 rate=0.0, drop_seed=None, floor=0.01):
-    """Reference composition with the materialized hash-noise field."""
+    """LEGACY reference composition with the materialized hash-noise field
+    (see module docstring for why this is not ``flex_reference``)."""
     b, h, n, dh = q.shape
     noise = uniform_field(sample_seed, b, h, n, n, round_up(n, TILE))
     exp_a = jnp.einsum("bhnk,hkj,bhmj->bhnm", q_hat, s_aff, k_hat)
@@ -59,13 +74,22 @@ def _xla_mirror(q, k, v, q_hat, k_hat, s_aff, pad, sample_seed,
     return out, graph_sums
 
 
+def _flash(q, k, v, q_hat, k_hat, s_aff, pad, sample_seed,
+           rate=0.0, drop_seed=None, floor=0.01, bwd="auto"):
+    """The old ``sbm_attention_flash`` contract on the flex core:
+    ``(out, ΣA per (batch, head))``."""
+    spec, aux = sbm_sampled_mod(q_hat, k_hat, s_aff, pad, sample_seed, floor)
+    out, extras = flex_attention(q, k, v, spec, aux, rate, drop_seed, bwd=bwd)
+    return out, extras["graph_sum"]
+
+
 SEED = jnp.int32(1234)
 DSEED = jnp.int32(777)
 
 
 def test_flash_forward_matches_xla_mirror():
     args = _inputs()
-    out_p, gs_p = sbm_attention_flash(*args, SEED)
+    out_p, gs_p = _flash(*args, SEED)
     out_x, gs_x = _xla_mirror(*args, SEED)
     np.testing.assert_array_equal(np.asarray(gs_p), np.asarray(gs_x))
     np.testing.assert_allclose(np.asarray(out_p), np.asarray(out_x), atol=2e-5)
@@ -75,14 +99,15 @@ def test_flash_forward_matches_xla_mirror():
 def test_flash_forward_nonaligned_and_multitile():
     # N=300 → 3 tiles of 128 with a ragged real region
     args = _inputs(b=1, h=2, n=300, dh=16, kk=4, seed=3)
-    out_p, gs_p = sbm_attention_flash(*args, SEED)
+    out_p, gs_p = _flash(*args, SEED)
     out_x, gs_x = _xla_mirror(*args, SEED)
     np.testing.assert_array_equal(np.asarray(gs_p), np.asarray(gs_x))
     np.testing.assert_allclose(np.asarray(out_p), np.asarray(out_x), atol=2e-5)
 
 
 @pytest.mark.slow
-def test_flash_grads_match_xla_mirror():
+@pytest.mark.parametrize("bwd", ["kernel", "reference"])
+def test_flash_grads_match_xla_mirror(bwd):
     args = _inputs(b=1, h=2, n=140, dh=16, kk=4, seed=1)
     q, k, v, q_hat, k_hat, s_aff, pad = args
     go = jax.random.normal(jax.random.key(9), q.shape)
@@ -92,7 +117,7 @@ def test_flash_grads_match_xla_mirror():
         return jnp.sum(out * go) + 1e-3 * jnp.sum(gs)
 
     f_p = lambda q, k, v, qh, kh, s: loss(
-        lambda *a: sbm_attention_flash(*a, pad, SEED), q, k, v, qh, kh, s)
+        lambda *a: _flash(*a, pad, SEED, bwd=bwd), q, k, v, qh, kh, s)
     f_x = lambda q, k, v, qh, kh, s: loss(
         lambda *a: _xla_mirror(*a, pad, SEED), q, k, v, qh, kh, s)
     gp = jax.grad(f_p, argnums=(0, 1, 2, 3, 4, 5))(q, k, v, q_hat, k_hat, s_aff)
@@ -108,13 +133,13 @@ def test_flash_dropout_fwd_bwd_match_mirror():
     args = _inputs(b=1, h=2, n=150, dh=16, kk=4, seed=2)
     q, k, v, q_hat, k_hat, s_aff, pad = args
     rate = 0.3
-    out_p, _ = sbm_attention_flash(*args, SEED, rate, DSEED)
+    out_p, _ = _flash(*args, SEED, rate, DSEED)
     out_x, _ = _xla_mirror(*args, SEED, rate, DSEED)
     np.testing.assert_allclose(np.asarray(out_p), np.asarray(out_x), atol=2e-5)
 
     go = jax.random.normal(jax.random.key(5), q.shape)
     f_p = lambda v_: jnp.sum(
-        sbm_attention_flash(q, k, v_, q_hat, k_hat, s_aff, pad, SEED, rate, DSEED)[0] * go)
+        _flash(q, k, v_, q_hat, k_hat, s_aff, pad, SEED, rate, DSEED)[0] * go)
     f_x = lambda v_: jnp.sum(
         _xla_mirror(q, k, v_, q_hat, k_hat, s_aff, pad, SEED, rate, DSEED)[0] * go)
     np.testing.assert_allclose(
@@ -124,7 +149,7 @@ def test_flash_dropout_fwd_bwd_match_mirror():
 
 def test_flash_under_jit():
     args = _inputs(b=1, h=1, n=64, dh=16, kk=3, seed=4)
-    fn = jax.jit(lambda *a: sbm_attention_flash(*a, SEED))
+    fn = jax.jit(lambda *a: _flash(*a, SEED))
     out, gs = fn(*args)
     assert out.shape == (1, 1, 64, 16)
     assert np.isfinite(np.asarray(out)).all()
@@ -188,34 +213,37 @@ def test_model_counter_train_step(tiny_config, synthetic_corpus):
 
 
 def test_flash_floor_zero_matches_mirror_and_skips_tiles():
-    """The sbm_floor=0.0 quirk-fix: parity holds between the flash kernel
+    """The sbm_floor=0.0 quirk-fix: parity holds between the flex kernel
     and the XLA mirror at floor 0, and structurally-dead cluster blocks
-    actually register on the in-kernel dead-tile counter."""
-    from csat_tpu.ops.sbm_flash_pallas import flash_tile_stats
-
+    actually register on the realized in-kernel skip counter."""
     b, h, n, dh, kk = 1, 2, 256, 16, 4
     q, k, v, q_hat, k_hat, s_aff, pad = _inputs(b=b, h=h, n=n, dh=dh, kk=kk)
     # drive the second k-tile's memberships to exact zero: with floor=0.0
     # every (q-tile, tile-1) pair samples an all-dead block
     k_hat = k_hat.at[:, :, 128:, :].set(0.0)
 
-    out_p, gs_p = sbm_attention_flash(
-        q, k, v, q_hat, k_hat, s_aff, pad, SEED, floor=0.0)
-    out_x, gs_x = _xla_mirror(
-        q, k, v, q_hat, k_hat, s_aff, pad, SEED, floor=0.0)
+    out_p, gs_p = _flash(q, k, v, q_hat, k_hat, s_aff, pad, SEED, floor=0.0)
+    out_x, gs_x = _xla_mirror(q, k, v, q_hat, k_hat, s_aff, pad, SEED, floor=0.0)
     np.testing.assert_array_equal(np.asarray(gs_p), np.asarray(gs_x))
     np.testing.assert_allclose(np.asarray(out_p), np.asarray(out_x), atol=2e-5)
 
-    stats = flash_tile_stats(q, k, v, q_hat, k_hat, s_aff, pad, SEED, floor=0.0)
+    spec, aux = sbm_sampled_mod(q_hat, k_hat, s_aff, pad, SEED, 0.0)
+    _, extras = flex_attention(q, k, v, spec, aux)
+    total = b * h * num_blocks(n)
+    skipped = float(jnp.sum(extras["skipped_blocks"]))
     # 2x2 tiles per (b,h): the (*, 1) column is dead => skip rate >= 1/2
-    assert stats["tiles_total"] == b * h * 4
-    assert stats["skip_rate"] >= 0.5, stats
+    assert num_blocks(n) == 4
+    assert skipped / total >= 0.5, extras
+    # the realized counter matches the XLA occupancy oracle exactly
+    np.testing.assert_array_equal(
+        np.asarray(extras["skipped_blocks"]),
+        np.asarray(reference_block_skip(spec, aux, geometry(q))))
     # at the reference floor the same inputs keep every tile alive (the
     # 1% Bernoulli floor resurrects the zeroed blocks)
-    stats_ref = flash_tile_stats(
-        q, k, v, q_hat, k_hat, s_aff, pad, SEED, floor=0.01)
-    assert stats_ref["tiles_dead"] == 0, stats_ref
-    assert stats_ref["edge_density"] > stats["edge_density"]
+    spec01, aux01 = sbm_sampled_mod(q_hat, k_hat, s_aff, pad, SEED, 0.01)
+    _, extras01 = flex_attention(q, k, v, spec01, aux01)
+    assert float(jnp.sum(extras01["skipped_blocks"])) == 0.0
+    assert float(jnp.sum(extras01["graph_sum"])) > float(jnp.sum(extras["graph_sum"]))
 
 
 def test_flash_floor_zero_grads_match_mirror():
@@ -228,7 +256,7 @@ def test_flash_floor_zero_grads_match_mirror():
         return jnp.sum(out * go) + 1e-3 * jnp.sum(gs)
 
     f_p = lambda qh, kh: loss(
-        lambda *a: sbm_attention_flash(q, k, v, *a, s_aff, pad, SEED, floor=0.0),
+        lambda *a: _flash(q, k, v, *a, s_aff, pad, SEED, floor=0.0),
         qh, kh)
     f_x = lambda qh, kh: loss(
         lambda *a: _xla_mirror(q, k, v, *a, s_aff, pad, SEED, floor=0.0),

@@ -7,7 +7,7 @@ import pytest
 
 from csat_tpu.models.ste import bernoulli_noise, sample_graph
 from csat_tpu.models.sbm import l1_normalize
-from csat_tpu.models.cse import disentangled_scores
+from csat_tpu.ops.mods import disentangled_scores
 from csat_tpu.models.pe import laplacian_pe
 from csat_tpu.train.loss import label_smoothing_loss
 from csat_tpu.train.optimizer import adamw
