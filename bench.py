@@ -128,6 +128,81 @@ def _apply_lengths(batch, lengths):
 
 PARITY_TOL = 1e-5  # pallas-vs-xla f32 loss tolerance on the bench fit
 
+# ---- perf observatory (ISSUE 10) -----------------------------------------
+
+_REGISTRY = None
+
+
+def _bench_registry():
+    """Process-local PR 7 metrics registry for the bench children: per-variant
+    peak memory gauge + cumulative compile wall-time counter.  Snapshots are
+    emitted as a ``metrics`` phase record so the parent can embed them."""
+    global _REGISTRY
+    if _REGISTRY is None:
+        from csat_tpu.obs import MetricsRegistry
+
+        _REGISTRY = MetricsRegistry()
+    return _REGISTRY
+
+
+def _peak_bytes():
+    """(peak_bytes, source) for the current process: the device allocator's
+    peak where the backend exposes one (TPU), host RSS otherwise (the CPU
+    backend allocates from the process heap, so RSS is the honest proxy —
+    psutil when available, ru_maxrss as the no-deps fallback)."""
+    import jax
+
+    try:
+        stats = jax.local_devices()[0].memory_stats() or {}
+        peak = int(stats.get("peak_bytes_in_use", 0))
+        if peak:
+            return peak, "device"
+    except Exception:  # noqa: BLE001 — CPU backends raise/return nothing
+        pass
+    try:
+        import psutil
+
+        return int(psutil.Process().memory_info().rss), "host_rss"
+    except Exception:  # noqa: BLE001
+        import resource
+
+        return (int(resource.getrusage(resource.RUSAGE_SELF).ru_maxrss) * 1024,
+                "host_rss_peak")
+
+
+def _record_variant_metrics(rec: dict, compile_s: float) -> None:
+    """Stamp memory/compile telemetry into a variant record AND the bench
+    metrics registry (gauge ``bench_peak_bytes``, counter
+    ``compile_seconds_total`` — the names the ROADMAP's equal-memory and
+    cold-start items scrape)."""
+    peak, src = _peak_bytes()
+    rec["peak_bytes"] = peak
+    rec["peak_bytes_source"] = src
+    reg = _bench_registry()
+    reg.gauge("bench_peak_bytes",
+              "peak memory of the last measured bench variant, bytes").set(peak)
+    reg.counter("compile_seconds_total",
+                "cumulative compile wall-time this bench session, "
+                "seconds").inc(round(compile_s, 3))
+
+
+def _history_path() -> str:
+    """The run-history ledger path (``csat_tpu/obs/perfdb.py``): the
+    ``BENCH_HISTORY_FILE`` env override, else the ``bench_history_file``
+    config knob; "" disables the ledger.  Relative paths anchor at the
+    repo root so tests can redirect everything through HERE."""
+    p = os.environ.get("BENCH_HISTORY_FILE")
+    if p is None:
+        try:
+            from csat_tpu.configs import get_config
+
+            p = get_config("python").bench_history_file
+        except Exception:  # noqa: BLE001 — the ledger is best-effort
+            p = "results/perf/history.jsonl"
+    if not p:
+        return ""
+    return p if os.path.isabs(p) else os.path.join(HERE, p)
+
 
 def _attention_phase_probe(cfg, key_pad, n_steps: int, trace_path: str):
     """Attention-vs-rest attribution probe (ISSUE 8 telemetry satellite).
@@ -386,6 +461,7 @@ def _measure_one(spec: str, heartbeat=None) -> dict:
         "phase_time": phase_time,
         **xla_mem,
     }
+    _record_variant_metrics(rec, t_compile)
     if attn_trace is not None:
         rec["attention_trace_file"] = attn_trace
     if skip_frac is not None:
@@ -457,6 +533,7 @@ def _measure_bucketed(backend: str, dtype: str, batch_size: int,
     t_compile = time.perf_counter()
     state = None
     programs, batches, sched = {}, {}, []
+    compile_s_per_bucket = {}
     for k, spec in enumerate(specs):
         steps_k = steps_per_bucket[k]
         if steps_k <= 0:
@@ -473,7 +550,12 @@ def _measure_bucketed(backend: str, dtype: str, batch_size: int,
         b = jax.tree.map(jax.device_put, b)
         if state is None:
             state = create_train_state(model, tx, b, seed=cfg.seed)
+        t_bucket = time.perf_counter()
         programs[k] = step.lower(state, b).compile()
+        # per-bucket compile wall-time (ISSUE 10): the cold-start ROADMAP
+        # item's per-program numbers, keyed by the bucket's (n, t) shape
+        compile_s_per_bucket[f"n{spec.n}_t{spec.t}"] = round(
+            time.perf_counter() - t_bucket, 2)
         batches[k] = b
         sched.extend([k] * steps_k)
     # deterministic interleave, as the training iterator would produce
@@ -503,11 +585,12 @@ def _measure_bucketed(backend: str, dtype: str, batch_size: int,
                    .get("peak_bytes_in_use", 0))
     except Exception:
         peak = 0
-    return {
+    rec = {
         "ok": True,
         "backend": backend,
         "dtype": dtype,
         "mode": "bucketed",
+        "compile_s_per_bucket": compile_s_per_bucket,
         "buckets": [
             {"n": specs[k].n, "t": specs[k].t,
              "batch_size": specs[k].batch_size,
@@ -525,6 +608,8 @@ def _measure_bucketed(backend: str, dtype: str, batch_size: int,
         "nodes_per_sec_per_chip": fed_nodes / dt / n_chips,
         "real_nodes_per_sec_per_chip": real_nodes / dt / n_chips,
     }
+    _record_variant_metrics(rec, t_compile)
+    return rec
 
 
 def _measure_serve(backend: str, dtype: str, num_slots: int,
@@ -721,7 +806,7 @@ def _measure_serve(backend: str, dtype: str, num_slots: int,
     tps = tps_on / n_chips
     base_tps = base_useful / base_wall / n_chips
     summ = engine.stats.summary(wall_s=engine_wall, n_chips=n_chips)
-    return {
+    rec = {
         "ok": True,
         "backend": backend,
         "dtype": dtype,
@@ -768,6 +853,8 @@ def _measure_serve(backend: str, dtype: str, num_slots: int,
         "nodes_per_sec_per_chip": 0.0,
         "real_nodes_per_sec_per_chip": 0.0,
     }
+    _record_variant_metrics(rec, t_compile)
+    return rec
 
 
 def _serve(specs_csv: str, soft_budget_s: float) -> None:
@@ -811,6 +898,31 @@ def _serve(specs_csv: str, soft_budget_s: float) -> None:
 
     enable_compilation_cache(CACHE_DIR)
 
+    # ---- calibration probes (ISSUE 10): measure the MACHINE first --------
+    # A seeded micro-benchmark suite (device FLOPs, memory bandwidth,
+    # dispatch latency, compile throughput) + machine fingerprint, emitted
+    # as its own phase record so the parent can stamp every published
+    # headline with the evidence needed to split a future delta into
+    # environment-vs-code.  Probes skip cleanly (never error) and the suite
+    # is budgeted, so a wedged backend costs at most the probe budget.
+    try:
+        from csat_tpu.configs import get_config as _get_config
+        from csat_tpu.obs.calibrate import (
+            PROBES, machine_fingerprint, run_calibration)
+
+        _c = _get_config("python")
+        emit({"phase": "calibration",
+              "machine_fingerprint": machine_fingerprint(),
+              "calibration": run_calibration(
+                  matmul_n=_c.calib_matmul_n,
+                  memory_mb=_c.calib_memory_mb,
+                  dispatch_iters=_c.calib_dispatch_iters,
+                  budget_s=_c.calib_budget_s,
+                  probes=_c.calib_probes or PROBES)})
+    except Exception as e:  # noqa: BLE001 — instrumentation must not kill a run
+        emit({"phase": "calibration_error",
+              "error": f"{type(e).__name__}: {e}"})
+
     for i, spec in enumerate(specs):
         left = soft_budget_s - (time.monotonic() - t0)
         # the floor must cover a worst-case compile: starting a device spec
@@ -829,6 +941,10 @@ def _serve(specs_csv: str, soft_budget_s: float) -> None:
         except Exception as e:  # noqa: BLE001 — record, keep going
             emit({"phase": "error", "spec": spec,
                   "error": f"{type(e).__name__}: {e}"})
+    try:  # PR 7 registry snapshot: bench_peak_bytes / compile_seconds_total
+        emit({"phase": "metrics", "snapshot": _bench_registry().snapshot()})
+    except Exception:  # noqa: BLE001
+        pass
     emit({"phase": "done"})
     print(json.dumps({"ok": True, "phase": "done"}))  # parent success marker
 
@@ -836,6 +952,114 @@ def _serve(specs_csv: str, soft_budget_s: float) -> None:
 # --------------------------------------------------------------------------
 # parent: orchestration, hard timeouts, guaranteed JSON emission
 # --------------------------------------------------------------------------
+
+def _git_rev() -> str:
+    try:
+        return subprocess.run(
+            ["git", "rev-parse", "--short", "HEAD"], cwd=HERE,
+            capture_output=True, text=True, timeout=10,
+        ).stdout.strip()
+    except Exception:  # noqa: BLE001 — provenance is best-effort
+        return ""
+
+
+def _observatory(out: dict, phases: list, reasons: list) -> None:
+    """Perf-observatory stage (ISSUE 10), run on the final JSON dict before
+    it is printed:
+
+    * stamp ``calibration`` + ``machine_fingerprint`` from the serve
+      children's calibration phase records (the record matching the
+      winning device, falling back to the last one measured);
+    * publish the headline both raw and calibration-normalized
+      (``nodes_per_sec_per_chip_cal`` = raw ÷ matmul-probe ratio vs the
+      ledger's reference fingerprint);
+    * run the regression gate against the ledger best: a normalized drop
+      beyond tolerance marks the record ``degraded`` with a structured
+      ``regression{}`` note (kind ``code``); a raw drop whose normalized
+      value held is annotated kind ``environment`` and still publishes;
+    * append the full record to the run-history ledger.
+
+    Best-effort by design: ledger or calibration trouble appends a note,
+    never blocks the JSON line (the bench's prime directive).
+    """
+    try:
+        from csat_tpu.obs import perfdb
+        from csat_tpu.obs.calibrate import normalization_ratio
+
+        cals = [p for p in phases if p.get("phase") == "calibration"]
+        match = [c for c in cals
+                 if (c.get("machine_fingerprint") or {}).get("platform")
+                 == out.get("device")]
+        cal_rec = (match or cals or [{}])[-1]
+        out["machine_fingerprint"] = cal_rec.get("machine_fingerprint")
+        out["calibration"] = cal_rec.get("calibration")
+        for p in phases:
+            if p.get("phase") == "calibration_error":
+                out["notes"] = "; ".join(filter(None, [
+                    out.get("notes"), f"calibration: {p.get('error')}"]))
+        snaps = [p["snapshot"] for p in phases
+                 if p.get("phase") == "metrics" and p.get("snapshot")]
+        if snaps:
+            merged = {}
+            for snap in snaps:  # one registry per serve child: totals sum
+                for k, v in snap.items():
+                    merged[k] = (merged.get(k, 0) + v
+                                 if k.endswith("_total") else v)
+            out["bench_metrics"] = merged
+
+        hist_path = _history_path()
+        history = perfdb.load_history(hist_path) if hist_path else []
+        ref = perfdb.reference_entry(history)
+        # no calibrated ledger entry yet: THIS run becomes the reference
+        # fingerprint (ratio 1.0 against itself)
+        ref_cal = (ref or {}).get("calibration") or out.get("calibration")
+        ratio = normalization_ratio(out.get("calibration"), ref_cal)
+        value = float(out.get("value") or 0.0)
+        out["nodes_per_sec_per_chip_cal"] = round(value / ratio, 1)
+        out["calibration_ratio_vs_reference"] = round(ratio, 4)
+        out["degraded_reasons"] = reasons
+
+        probe = {"metric": out.get("metric", perfdb.HEADLINE_METRIC),
+                 "value": value,
+                 "value_cal": out["nodes_per_sec_per_chip_cal"],
+                 "calibration": out.get("calibration"),
+                 "degraded_reasons": reasons}
+        regression = perfdb.regression_check(probe, history) if value else None
+        if regression is not None:
+            out["regression"] = regression
+            if regression["kind"] == "code":
+                # fail loudly: a normalized drop the machine cannot explain
+                # is a code regression — never silently published
+                out["degraded"] = True
+                reasons.append("regression")
+                note = (
+                    f"regression gate: normalized headline dropped "
+                    f"{regression['normalized_drop_pct']}% vs "
+                    f"{regression['vs_run']} (tol "
+                    f"{regression['drop_tol_pct']}%) — attributed to code")
+            else:
+                note = (
+                    f"environment slowdown: raw headline dropped "
+                    f"{regression['raw_drop_pct']}% vs {regression['vs_run']} "
+                    f"but the calibration-normalized headline held "
+                    f"({regression['normalized_drop_pct']}%)")
+            out["notes"] = "; ".join(filter(None, [out.get("notes"), note]))
+
+        if hist_path:
+            run_id = "run_" + time.strftime("%Y%m%dT%H%M%SZ", time.gmtime())
+            reference = None
+            if ref is not None:
+                reference = {
+                    "run_id": ref.get("run_id"),
+                    "fingerprint_id": (ref.get("machine_fingerprint")
+                                       or {}).get("id"),
+                }
+            perfdb.append_entry(hist_path, perfdb.make_entry(
+                out, run_id=run_id, git_rev=_git_rev() or None,
+                reference=reference))
+    except Exception as e:  # noqa: BLE001 — the JSON line must still appear
+        out["notes"] = "; ".join(filter(None, [
+            out.get("notes"), f"perf ledger error: {type(e).__name__}: {e}"]))
 
 def _run_child(args, timeout_s: float, cpu_only: bool = False):
     """Run one child with a hard timeout, killing its whole process group.
@@ -1183,7 +1407,9 @@ def main() -> None:
                                      "telemetry_overhead_pct", "phase_time",
                                      "trace_file", "block_skip_frac",
                                      "mask_density_per_layer", "parity",
-                                     "attention_trace_file")
+                                     "attention_trace_file", "compile_s",
+                                     "compile_s_per_bucket", "peak_bytes",
+                                     "peak_bytes_source")
                    if k in r}
             # self-describing artifact (r4 verdict weak #6): pallas on CPU is
             # pl.pallas_call(interpret=True) — a correctness canary, not a
@@ -1199,6 +1425,8 @@ def main() -> None:
             return rec
 
         out["all_variants"] = [_variant_rec(r) for r in results]
+        reasons = ((["no_device"] if degraded else [])
+                   + (["parity"] if bad_parity else []))
         for r in results:
             print(f"# {r['backend']}:{r['dtype']} on {r['device']}: "
                   f"{r['nodes_per_sec_per_chip']:.0f} nodes/s/chip "
@@ -1216,6 +1444,9 @@ def main() -> None:
         }
         if tpu_session:
             out["tpu_session"] = tpu_session
+        reasons = ["no_results"]
+    # calibration stamp + normalized headline + regression gate + ledger
+    _observatory(out, phases, reasons)
     print(json.dumps(out))
 
 

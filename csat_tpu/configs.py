@@ -319,6 +319,28 @@ class Config:
     obs_metrics_file: str = ""
     # snapshot/heartbeat cadence for obs_metrics_file, seconds
     obs_metrics_every_s: float = 10.0
+    # --- perf observatory (csat_tpu/obs/{calibrate,perfdb}.py; ISSUE 10) ---
+    # hardware calibration probes run at the top of every bench session
+    # (device FLOPs / memory bandwidth / dispatch latency / compile
+    # throughput); the matmul probe's ratio vs the ledger's reference
+    # fingerprint normalizes the headline (`*_cal` fields). () = all
+    # probes; a subset (e.g. ("matmul_f32",)) trims the suite
+    calib_probes: Tuple[str, ...] = ()
+    # square matmul operand dim for the FLOPs probe
+    calib_matmul_n: int = 512
+    # copy/reduce array size for the bandwidth probe, MiB
+    calib_memory_mb: int = 64
+    # donated tiny-step loop length for the dispatch-latency probe
+    calib_dispatch_iters: int = 50
+    # wall-clock budget for the WHOLE probe suite; overrunning probes are
+    # skipped with a reason, never errored (acceptance: <60s on the CPU box)
+    calib_budget_s: float = 45.0
+    # append-only bench run-history ledger (obs/perfdb.py): every bench
+    # run's full record + calibration + fingerprint; tools/perf_compare.py
+    # diffs entries and attributes deltas to {environment, code,
+    # unexplained}. Relative paths resolve against the bench's repo root.
+    # "" disables the ledger (and with it the regression gate)
+    bench_history_file: str = "results/perf/history.jsonl"
     # per-iteration scalar-log cadence for the training loop (scalars.jsonl
     # `it` records, mirroring the reference's every-50-iters TensorBoard
     # loss): log every N iterations; 0 disables the per-iteration records
@@ -430,6 +452,16 @@ class Config:
         assert self.snapshot_every_steps >= 0, self.snapshot_every_steps
         assert self.obs_events >= 0, self.obs_events
         assert self.obs_metrics_every_s > 0, self.obs_metrics_every_s
+        from csat_tpu.obs.calibrate import PROBES as _CALIB_PROBES
+
+        assert all(p in _CALIB_PROBES for p in self.calib_probes), (
+            f"calib_probes {self.calib_probes}: each must be one of "
+            f"{_CALIB_PROBES}"
+        )
+        assert self.calib_matmul_n >= 8, self.calib_matmul_n
+        assert self.calib_memory_mb >= 1, self.calib_memory_mb
+        assert self.calib_dispatch_iters >= 1, self.calib_dispatch_iters
+        assert self.calib_budget_s > 0, self.calib_budget_s
         assert self.scalar_log_every >= 0, self.scalar_log_every
         assert self.bucket_token_budget >= 0, self.bucket_token_budget
         assert all(n >= 1 for n in self.bucket_src_lens), self.bucket_src_lens

@@ -13,6 +13,12 @@ layer.
 * ``obs/trace.py`` — exports recorder spans as Chrome/Perfetto
   trace-event JSON and brackets them with ``jax.profiler.TraceAnnotation``
   so host phases line up with device traces from ``--profile``.
+* ``obs/calibrate.py`` — seeded hardware calibration probes (device
+  FLOPs, memory bandwidth, dispatch latency, compile throughput) and the
+  machine fingerprint stamped into every bench record (ISSUE 10).
+* ``obs/perfdb.py`` — the append-only bench run-history ledger
+  (``results/perf/history.jsonl``) and the code-vs-environment regression
+  attribution/gate built on the calibration ratios.
 
 All instrumentation is host-side (host clocks only, no extra device
 syncs) and gated by the ``obs_*`` config family — cheap-on by default.
@@ -20,6 +26,11 @@ syncs) and gated by the ``obs_*`` config family — cheap-on by default.
 metrics/events files.
 """
 
+from csat_tpu.obs.calibrate import (  # noqa: F401
+    machine_fingerprint,
+    normalization_ratio,
+    run_calibration,
+)
 from csat_tpu.obs.events import EventRecorder, Span  # noqa: F401
 from csat_tpu.obs.metrics import (  # noqa: F401
     Counter,

@@ -1,0 +1,249 @@
+"""Hardware calibration probes + machine fingerprint (ISSUE 10).
+
+A bench number is only evidence if it can be compared across runs and
+machines.  The r05→r08 episode made the cost of *not* having this concrete:
+the bench box silently slowed ~1.55x, the recorded 277 nodes/s/chip headline
+became unreproducible by ANY code version, and proving PR 8 wasn't a
+regression took a hand-run interleaved A/B against a worktree.  This module
+is the automated version of that A/B: a seeded suite of micro-benchmarks
+("calibration probes") that measures the *machine* at the top of every bench
+session, so a headline delta can be split into environment (the probes moved
+too) vs code (the probes were flat but the headline moved).
+
+Probes (all deterministic shapes, all host-clock timed, median-of-k):
+
+* ``matmul_f32_gflops`` / ``matmul_bf16_gflops`` — blocked square jit
+  matmul: device FLOP throughput, the ratio used for headline
+  normalization (training steps are matmul-dominated);
+* ``memory_gbps`` — large-array copy + reduce: memory bandwidth;
+* ``dispatch_us`` — a tiny donated jit step in a loop: per-call dispatch
+  latency (host→device overhead, the serving tick floor);
+* ``compile_s`` — one fixed-shape trace+lower+compile with the persistent
+  compilation cache bypassed: compile throughput (the cold-start axis).
+
+A probe that cannot run (missing backend feature, budget exhausted) is
+*skipped with a reason*, never errored — a bench session must not die to
+its own instrumentation.  The whole suite is budgeted (<60s on the CPU
+box; see ``calib_budget_s``).
+
+The fingerprint is the identity key for "same machine?" questions:
+host, device platform/kind/count, jax version, cpu count — plus a short
+stable digest (``id``) ledger tooling can compare cheaply.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import os
+import platform as _platform
+import socket
+import time
+from typing import Callable, Dict, Optional, Tuple
+
+__all__ = [
+    "PROBES", "REFERENCE_PROBE", "machine_fingerprint", "fingerprint_id",
+    "run_calibration", "normalization_ratio", "normalize",
+]
+
+PROBES: Tuple[str, ...] = (
+    "matmul_f32", "matmul_bf16", "memory", "dispatch", "compile")
+
+# the probe whose ratio normalizes headline throughput across machines
+# (training steps are matmul-bound; see ``normalization_ratio``)
+REFERENCE_PROBE = "matmul_f32_gflops"
+
+_FP_KEYS = ("host", "platform", "device_kind", "device_count",
+            "jax_version", "cpu_count")
+
+
+def fingerprint_id(fp: Dict[str, object]) -> str:
+    """Short stable digest of the identity fields (order-independent of the
+    dict, independent of the ``id`` field itself)."""
+    basis = "|".join(f"{k}={fp.get(k)}" for k in _FP_KEYS)
+    return hashlib.blake2b(basis.encode(), digest_size=6).hexdigest()
+
+
+def machine_fingerprint() -> Dict[str, object]:
+    """Identity of the machine + software stack a bench record was taken
+    on.  Stable within a process (same inputs → same dict)."""
+    import jax
+
+    devs = jax.devices()
+    fp: Dict[str, object] = {
+        "host": socket.gethostname(),
+        "platform": devs[0].platform,
+        "device_kind": str(getattr(devs[0], "device_kind", devs[0].platform)),
+        "device_count": len(devs),
+        "jax_version": jax.__version__,
+        "cpu_count": os.cpu_count() or 1,
+        "python_version": _platform.python_version(),
+    }
+    fp["id"] = fingerprint_id(fp)
+    return fp
+
+
+def _median(xs) -> float:
+    xs = sorted(xs)
+    n = len(xs)
+    return xs[n // 2] if n % 2 else 0.5 * (xs[n // 2 - 1] + xs[n // 2])
+
+
+def _timed(fn: Callable[[], object], repeats: int) -> float:
+    """Median wall-clock of ``repeats`` calls (one untimed warmup call has
+    already happened by contract — compiles never pollute the sample)."""
+    samples = []
+    for _ in range(max(repeats, 1)):
+        t0 = time.perf_counter()
+        fn()
+        samples.append(time.perf_counter() - t0)
+    return _median(samples)
+
+
+def _probe_matmul(dtype: str, n: int, repeats: int) -> float:
+    import jax
+    import jax.numpy as jnp
+
+    x = jax.random.normal(jax.random.key(0), (n, n), jnp.float32)
+    y = jax.random.normal(jax.random.key(1), (n, n), jnp.float32)
+    if dtype != "float32":
+        x, y = x.astype(dtype), y.astype(dtype)
+    f = jax.jit(lambda a, b: a @ b)
+    jax.block_until_ready(f(x, y))  # compile
+    dt = _timed(lambda: jax.block_until_ready(f(x, y)), repeats)
+    return (2.0 * n * n * n) / dt / 1e9  # GFLOP/s
+
+
+def _probe_memory(mb: int, repeats: int) -> float:
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    n = mb * (1 << 20) // 4  # f32 elements
+    x = jnp.asarray(np.arange(n, dtype=np.float32))
+    copy = jax.jit(lambda a: a + 1.0)   # read + write: 2·bytes
+    red = jax.jit(jnp.sum)              # read: 1·bytes
+    jax.block_until_ready((copy(x), red(x)))  # compile
+
+    def both():
+        jax.block_until_ready((copy(x), red(x)))
+
+    dt = _timed(both, repeats)
+    return (3.0 * n * 4) / dt / 1e9  # GB/s moved
+
+
+def _probe_dispatch(iters: int, repeats: int) -> float:
+    import jax
+    import jax.numpy as jnp
+
+    f = jax.jit(lambda a: a + 1.0, donate_argnums=0)
+    x = jnp.zeros((8,), jnp.float32)
+    x = jax.block_until_ready(f(x))  # compile (donation rebinds below)
+
+    def loop():
+        nonlocal x
+        for _ in range(iters):
+            x = f(x)
+        jax.block_until_ready(x)
+
+    dt = _timed(loop, repeats)
+    return dt / iters * 1e6  # µs per donated step
+
+
+def _probe_compile() -> float:
+    """One fixed-shape trace+lower+compile, persistent cache bypassed so a
+    warm ``.jax_cache`` cannot turn the probe into a disk-read benchmark."""
+    import jax
+    import jax.numpy as jnp
+
+    def f(x, w1, w2):
+        h = jnp.tanh(x @ w1)
+        return jnp.sum(jax.nn.softmax(h @ w2) ** 2)
+
+    args = (jnp.zeros((16, 64)), jnp.zeros((64, 128)), jnp.zeros((128, 32)))
+    cache_off = False
+    try:
+        jax.config.update("jax_enable_compilation_cache", False)
+        cache_off = True
+    except Exception:  # unknown flag on some versions — probe still runs
+        pass
+    try:
+        t0 = time.perf_counter()
+        jax.jit(jax.grad(f, argnums=(1, 2))).lower(*args).compile()
+        return time.perf_counter() - t0
+    finally:
+        if cache_off:
+            jax.config.update("jax_enable_compilation_cache", True)
+
+
+def run_calibration(*, matmul_n: int = 512, memory_mb: int = 64,
+                    dispatch_iters: int = 50, repeats: int = 3,
+                    budget_s: float = 45.0,
+                    probes: Tuple[str, ...] = PROBES) -> Dict[str, object]:
+    """Run the probe suite; returns the ``calibration{}`` block stamped
+    into every bench record.
+
+    Never raises: a probe that fails or runs out of budget lands in
+    ``skipped`` with a reason string.  Values are floats in the units the
+    key names (``_gflops``, ``_gbps``, ``_us``, ``_s``).
+    """
+    t0 = time.monotonic()
+    out: Dict[str, float] = {}
+    skipped: Dict[str, str] = {}
+    runners: Dict[str, Tuple[str, Callable[[], float]]] = {
+        "matmul_f32": ("matmul_f32_gflops",
+                       lambda: _probe_matmul("float32", matmul_n, repeats)),
+        "matmul_bf16": ("matmul_bf16_gflops",
+                        lambda: _probe_matmul("bfloat16", matmul_n, repeats)),
+        "memory": ("memory_gbps", lambda: _probe_memory(memory_mb, repeats)),
+        "dispatch": ("dispatch_us",
+                     lambda: _probe_dispatch(dispatch_iters, repeats)),
+        "compile": ("compile_s", _probe_compile),
+    }
+    for name in probes:
+        if name not in runners:
+            skipped[name] = "unknown probe"
+            continue
+        if time.monotonic() - t0 > budget_s:
+            skipped[name] = f"budget ({budget_s:.0f}s) exhausted"
+            continue
+        key, fn = runners[name]
+        try:
+            v = float(fn())
+            if not (v == v and abs(v) != float("inf")):  # NaN/Inf guard
+                raise FloatingPointError(f"non-finite probe value {v}")
+            out[key] = round(v, 4)
+        except Exception as e:  # noqa: BLE001 — skipped cleanly, never errored
+            skipped[name] = f"{type(e).__name__}: {e}"
+    return {
+        "probes": out,
+        "skipped": skipped,
+        "elapsed_s": round(time.monotonic() - t0, 2),
+        "params": {"matmul_n": matmul_n, "memory_mb": memory_mb,
+                   "dispatch_iters": dispatch_iters, "repeats": repeats},
+    }
+
+
+def normalization_ratio(calibration: Optional[dict],
+                        reference_calibration: Optional[dict]) -> float:
+    """This machine's speed relative to the ledger's reference fingerprint,
+    from the matmul probe: >1 = faster box than the reference, <1 = slower.
+
+    ``value_cal = value / ratio`` re-expresses a headline as "what the
+    reference machine would have measured", so ``value == value_cal *
+    ratio`` round-trips exactly.  1.0 whenever either side lacks the probe
+    (legacy ``calibration: null`` entries stay raw == normalized).
+    """
+    try:
+        now = float(calibration["probes"][REFERENCE_PROBE])  # type: ignore[index]
+        ref = float(reference_calibration["probes"][REFERENCE_PROBE])  # type: ignore[index]
+        if now > 0 and ref > 0:
+            return now / ref
+    except (KeyError, TypeError, ValueError):
+        pass
+    return 1.0
+
+
+def normalize(value: float, calibration: Optional[dict],
+              reference_calibration: Optional[dict]) -> float:
+    """Calibration-normalized headline (see :func:`normalization_ratio`)."""
+    return value / normalization_ratio(calibration, reference_calibration)

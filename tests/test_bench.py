@@ -17,6 +17,7 @@ def bench(tmp_path, monkeypatch):
     monkeypatch.setenv("BENCH_BUDGET_S", "1200")
     monkeypatch.setenv("BENCH_PROBE_S", "120")
     monkeypatch.delenv("BENCH_VARIANTS", raising=False)
+    monkeypatch.delenv("BENCH_HISTORY_FILE", raising=False)
     import bench as mod
 
     mod = importlib.reload(mod)
@@ -365,6 +366,137 @@ def test_vs_baseline_ratio(bench, monkeypatch, tmp_path, capsys):
     out = _run_main(bench, capsys)
     assert out["vs_baseline"] == 4.5
     assert out["baseline_device"] == "cpu"
+
+
+# --------------------------------------------------------------------------
+# perf observatory (ISSUE 10): calibration embedding + ledger + gate
+# --------------------------------------------------------------------------
+
+def _cal_block(gflops):
+    return {"probes": {"matmul_f32_gflops": gflops, "memory_gbps": 5.0},
+            "skipped": {}, "elapsed_s": 1.0, "params": {}}
+
+
+def _fingerprint(platform="tpu"):
+    return {"host": "box", "platform": platform, "device_kind": platform,
+            "device_count": 1, "jax_version": "0.0", "cpu_count": 1,
+            "id": "abc123"}
+
+
+def _serve_with_observatory(mod, specs, nodes, gflops, snapshot=None):
+    """Emit what a real serve child writes: calibration first, results,
+    metrics snapshot, done."""
+    _emit(mod, {"phase": "calibration", "machine_fingerprint": _fingerprint(),
+                "calibration": _cal_block(gflops)})
+    for spec in specs:
+        _emit(mod, {"phase": "start", "spec": spec})
+        _emit(mod, _result(spec, nodes))
+    if snapshot:
+        _emit(mod, {"phase": "metrics", "snapshot": snapshot})
+    _emit(mod, {"phase": "done"})
+
+
+def _observatory_child(mod, nodes, gflops, snapshot=None):
+    def fake_child(args, timeout_s, cpu_only=False):
+        if args[0] == "--probe":
+            return {"ok": True, "platform": "tpu", "n_devices": 1}, None
+        _serve_with_observatory(mod, args[1].split(","), nodes, gflops,
+                                snapshot)
+        return {"ok": True, "phase": "done"}, None
+    return fake_child
+
+
+def test_calibration_and_metrics_embed_in_record(bench, monkeypatch, capsys):
+    """The child's calibration phase record lands in the final JSON as
+    machine_fingerprint + calibration; the metrics snapshot becomes
+    bench_metrics; the headline is published raw AND normalized."""
+    monkeypatch.setenv("BENCH_HISTORY_FILE",
+                       bench.os.path.join(bench.HERE, "hist.jsonl"))
+    snap = {"bench_peak_bytes": 4096, "compile_seconds_total": 12.5}
+    monkeypatch.setattr(bench, "_run_child",
+                        _observatory_child(bench, 100.0, 50.0, snap))
+    out = _run_main(bench, capsys)
+    assert out["machine_fingerprint"]["id"] == "abc123"
+    assert out["calibration"]["probes"]["matmul_f32_gflops"] == 50.0
+    assert out["bench_metrics"] == snap
+    # first calibrated run anchors the ledger: normalized == raw
+    assert out["nodes_per_sec_per_chip_cal"] == out["value"]
+    assert out["calibration_ratio_vs_reference"] == 1.0
+    assert out["degraded_reasons"] == []
+    assert "regression" not in out
+
+
+def test_two_runs_append_two_ledger_entries(bench, monkeypatch, capsys):
+    """Acceptance drill: bench twice → two history entries; the diff between
+    them attributes the delta as environment/noise, not code."""
+    from csat_tpu.obs import perfdb
+
+    path = bench.os.path.join(bench.HERE, "hist.jsonl")
+    monkeypatch.setenv("BENCH_HISTORY_FILE", path)
+    monkeypatch.setattr(bench, "_run_child",
+                        _observatory_child(bench, 100.0, 50.0))
+    _run_main(bench, capsys)
+    monkeypatch.setattr(bench, "_run_child",
+                        _observatory_child(bench, 102.0, 50.5))
+    _run_main(bench, capsys)
+    hist = perfdb.load_history(path)
+    assert len(hist) == 2
+    assert hist[0]["value"] == 100.0
+    assert hist[1]["value"] == 102.0
+    for e in hist:
+        assert e["calibration"]["probes"]["matmul_f32_gflops"] in (50.0, 50.5)
+        assert e["record"]["all_variants"]
+    # second entry records which run anchored its normalization
+    assert hist[1]["reference"]["run_id"] == hist[0]["run_id"]
+    att = perfdb.attribute_delta(hist[0], hist[1])
+    assert att["verdict"] == "noise"
+
+
+def test_regression_gate_marks_record_degraded(bench, monkeypatch, capsys):
+    """Synthetic 2x slowdown with flat calibration: the gate must attribute
+    it to code, mark the record degraded and say so in notes."""
+    monkeypatch.setenv("BENCH_HISTORY_FILE",
+                       bench.os.path.join(bench.HERE, "hist.jsonl"))
+    monkeypatch.setattr(bench, "_run_child",
+                        _observatory_child(bench, 200.0, 50.0))
+    _run_main(bench, capsys)
+    monkeypatch.setattr(bench, "_run_child",
+                        _observatory_child(bench, 100.0, 50.0))
+    out = _run_main(bench, capsys)
+    assert out["regression"]["kind"] == "code"
+    assert out["degraded"] is True
+    assert "regression" in out["degraded_reasons"]
+    assert "regression gate" in out["notes"]
+    assert "attributed to code" in out["notes"]
+
+
+def test_environment_slowdown_annotated_not_degraded(bench, monkeypatch, capsys):
+    """The r05→r08 shape: headline AND calibration probes both halve. The
+    record publishes (no degraded), annotated kind environment."""
+    monkeypatch.setenv("BENCH_HISTORY_FILE",
+                       bench.os.path.join(bench.HERE, "hist.jsonl"))
+    monkeypatch.setattr(bench, "_run_child",
+                        _observatory_child(bench, 200.0, 50.0))
+    _run_main(bench, capsys)
+    monkeypatch.setattr(bench, "_run_child",
+                        _observatory_child(bench, 100.0, 25.0))
+    out = _run_main(bench, capsys)
+    assert out["regression"]["kind"] == "environment"
+    assert "degraded" not in out
+    assert "regression" not in out["degraded_reasons"]
+    assert "environment slowdown" in out["notes"]
+    # normalized headline is flat: raw halved, machine halved
+    assert out["nodes_per_sec_per_chip_cal"] == pytest.approx(200.0, abs=1.0)
+
+
+def test_ledger_disabled_still_publishes(bench, monkeypatch, capsys):
+    monkeypatch.setenv("BENCH_HISTORY_FILE", "")  # "" disables the ledger
+    monkeypatch.setattr(bench, "_run_child",
+                        _observatory_child(bench, 100.0, 50.0))
+    out = _run_main(bench, capsys)
+    assert out["value"] == 100.0
+    assert out["nodes_per_sec_per_chip_cal"] == out["value"]
+    assert "perf ledger error" not in out.get("notes", "")
 
 
 def test_cpu_ratio_uses_same_batch_baseline(bench, monkeypatch, tmp_path, capsys):

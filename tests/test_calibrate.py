@@ -1,0 +1,337 @@
+"""Perf observatory (ISSUE 10): calibration probes, machine fingerprint,
+ledger round-trips, delta attribution and the regression gate.
+
+The probes run real (tiny) jax work on whatever backend the suite uses —
+the contract under test is "finite numbers or a clean skip, never an
+error".  Everything downstream (perfdb, perf_compare, obs_report
+--history) is host-side pure Python and is drilled with synthetic
+entries, including the two motivating scenarios: a genuine code
+regression on a steady machine, and the r05→r08 episode (machine slowed,
+code held).
+"""
+
+import json
+import math
+import os
+
+import pytest
+
+from csat_tpu.obs import perfdb
+from csat_tpu.obs.calibrate import (
+    PROBES,
+    REFERENCE_PROBE,
+    fingerprint_id,
+    machine_fingerprint,
+    normalization_ratio,
+    normalize,
+    run_calibration,
+)
+
+CAL_KW = dict(matmul_n=128, memory_mb=4, dispatch_iters=10, repeats=2)
+
+
+@pytest.fixture(scope="module")
+def cal():
+    return run_calibration(**CAL_KW)
+
+
+# --------------------------------------------------------------------------
+# probes + fingerprint
+# --------------------------------------------------------------------------
+
+def test_probes_finite_and_complete(cal):
+    # every probe either produced a finite positive number or a reasoned skip
+    assert set(cal["skipped"]) | {
+        {"matmul_f32": "matmul_f32_gflops",
+         "matmul_bf16": "matmul_bf16_gflops",
+         "memory": "memory_gbps",
+         "dispatch": "dispatch_us",
+         "compile": "compile_s"}[k]
+        for k in PROBES if k not in cal["skipped"]
+    } >= set(cal["probes"])
+    for key, v in cal["probes"].items():
+        assert math.isfinite(v) and v > 0, (key, v)
+    # on this image all five run (CPU backend supports everything)
+    assert REFERENCE_PROBE in cal["probes"]
+    assert cal["elapsed_s"] < 60.0
+    assert cal["params"]["matmul_n"] == 128
+
+
+def test_probe_subset_and_unknown_skip():
+    out = run_calibration(probes=("dispatch", "nonesuch"), **CAL_KW)
+    assert set(out["probes"]) <= {"dispatch_us"}
+    assert out["skipped"]["nonesuch"] == "unknown probe"
+
+
+def test_budget_exhaustion_skips_cleanly():
+    out = run_calibration(budget_s=-1.0, **CAL_KW)
+    assert out["probes"] == {}
+    assert set(out["skipped"]) == set(PROBES)
+    assert all("budget" in r for r in out["skipped"].values())
+
+
+def test_fingerprint_stable_within_process():
+    a, b = machine_fingerprint(), machine_fingerprint()
+    assert a == b
+    assert a["id"] == fingerprint_id(a)
+    assert a["device_count"] >= 1
+    # the id digests identity fields only — adding noise keys changes nothing
+    assert fingerprint_id({**a, "extra": "x"}) == a["id"]
+    assert fingerprint_id({**a, "host": "elsewhere"}) != a["id"]
+
+
+# --------------------------------------------------------------------------
+# normalization
+# --------------------------------------------------------------------------
+
+def _cal_with(gflops):
+    return {"probes": {REFERENCE_PROBE: gflops}, "skipped": {}}
+
+
+def test_normalization_round_trip():
+    now, ref = _cal_with(200.0), _cal_with(100.0)
+    ratio = normalization_ratio(now, ref)
+    assert ratio == pytest.approx(2.0)
+    # value == value_cal * ratio round-trips exactly
+    assert normalize(500.0, now, ref) * ratio == pytest.approx(500.0)
+    # self-normalization is the identity
+    assert normalization_ratio(now, now) == pytest.approx(1.0)
+
+
+def test_normalization_missing_calibration_is_identity():
+    assert normalization_ratio(None, _cal_with(100.0)) == 1.0
+    assert normalization_ratio(_cal_with(100.0), None) == 1.0
+    assert normalization_ratio({"probes": {}}, _cal_with(1.0)) == 1.0
+    assert normalization_ratio(_cal_with(0.0), _cal_with(1.0)) == 1.0
+
+
+# --------------------------------------------------------------------------
+# ledger
+# --------------------------------------------------------------------------
+
+def _entry(run_id, value, gflops=None, value_cal=None, reasons=(), ts=0.0):
+    bench_out = {
+        "metric": perfdb.HEADLINE_METRIC,
+        "value": value,
+        "nodes_per_sec_per_chip_cal": value_cal if value_cal is not None
+        else value,
+        "calibration": _cal_with(gflops) if gflops is not None else None,
+        "machine_fingerprint": {"host": "box", "platform": "cpu", "id": "x"},
+        "degraded_reasons": list(reasons),
+    }
+    return perfdb.make_entry(bench_out, run_id=run_id, ts=ts)
+
+
+def test_ledger_append_read_schema(tmp_path):
+    path = str(tmp_path / "sub" / "history.jsonl")  # dir is created
+    e1 = _entry("run_a", 100.0, gflops=100.0)
+    e2 = _entry("run_b", 110.0, gflops=100.0)
+    perfdb.append_entry(path, e1)
+    perfdb.append_entry(path, e2)
+    # a corrupt line and a non-entry object must be skipped, not fatal
+    with open(path, "a") as f:
+        f.write("{not json\n")
+        f.write(json.dumps({"hello": "world"}) + "\n")
+    hist = perfdb.load_history(path)
+    assert [e["run_id"] for e in hist] == ["run_a", "run_b"]
+    for e in hist:
+        assert e["schema"] == perfdb.SCHEMA_VERSION
+        assert e["metric"] == perfdb.HEADLINE_METRIC
+        assert {"run_id", "ts", "value", "value_cal", "calibration",
+                "machine_fingerprint", "degraded_reasons",
+                "record"} <= set(e)
+    assert perfdb.load_history(str(tmp_path / "missing.jsonl")) == []
+
+
+def test_reference_entry_is_first_calibrated():
+    hist = [_entry("legacy", 50.0),            # calibration: null
+            _entry("first_cal", 80.0, gflops=100.0),
+            _entry("later", 90.0, gflops=120.0)]
+    ref = perfdb.reference_entry(hist)
+    assert ref is not None and ref["run_id"] == "first_cal"
+
+
+def test_best_entry_excludes_untrusted():
+    hist = [_entry("ok", 100.0, gflops=100.0, reasons=["no_device"]),
+            _entry("bad_parity", 500.0, gflops=100.0, reasons=["parity"]),
+            _entry("regressed", 400.0, gflops=100.0, reasons=["regression"]),
+            _entry("empty", 0.0)]
+    best = perfdb.best_entry(hist)
+    # no_device (the CPU box's permanent state) stays eligible;
+    # parity/regression records never become the baseline
+    assert best is not None and best["run_id"] == "ok"
+    assert perfdb.last_entry(hist)["run_id"] == "regressed"
+
+
+# --------------------------------------------------------------------------
+# attribution + the regression gate
+# --------------------------------------------------------------------------
+
+def test_attribution_noise_on_steady_machine():
+    a = _entry("a", 100.0, gflops=100.0)
+    b = _entry("b", 102.0, gflops=100.0)
+    att = perfdb.attribute_delta(a, b)
+    assert att["comparable"] and att["calibrated"]
+    assert att["verdict"] == "noise"
+    assert att["code_pct"] == 0.0
+    assert abs(att["unexplained_pct"]) < perfdb.NOISE_TOL * 100
+
+
+def test_attribution_code_regression_flat_calibration():
+    # synthetic 2x slowdown, probes flat → all code
+    a = _entry("a", 200.0, gflops=100.0)
+    b = _entry("b", 100.0, gflops=100.0)
+    att = perfdb.attribute_delta(a, b)
+    assert att["verdict"] == "code_regression"
+    assert att["code_pct"] == pytest.approx(-50.0, abs=0.1)
+    assert att["environment_pct"] == 0.0
+
+
+def test_attribution_environment_only_slowdown():
+    # the r05→r08 episode: headline AND probes both dropped ~1.55x
+    a = _entry("a", 155.0, gflops=155.0)
+    b = _entry("b", 100.0, gflops=100.0)
+    att = perfdb.attribute_delta(a, b)
+    assert att["verdict"] == "environment"
+    assert att["environment_pct"] == pytest.approx(-35.48, abs=0.1)
+    assert att["code_pct"] == 0.0
+    # env + residual recompose to the total in log space
+    total = (1 + att["environment_pct"] / 100) * \
+        (1 + att["code_pct"] / 100) * (1 + att["unexplained_pct"] / 100)
+    assert total == pytest.approx(1 + att["total_pct"] / 100, rel=1e-3)
+
+
+def test_attribution_unattributable_without_calibration():
+    att = perfdb.attribute_delta(_entry("a", 200.0), _entry("b", 100.0))
+    assert att["comparable"] and not att["calibrated"]
+    assert att["verdict"] == "unattributable"
+    assert att["environment_pct"] == 0.0 and att["code_pct"] == 0.0
+    bad = perfdb.attribute_delta(_entry("a", 0.0), _entry("b", 100.0))
+    assert not bad["comparable"]
+
+
+def test_gate_fires_on_code_regression():
+    hist = [_entry("best", 200.0, gflops=100.0)]
+    fresh = _entry("fresh", 100.0, gflops=100.0)  # 2x slower, probes flat
+    note = perfdb.regression_check(fresh, hist)
+    assert note is not None
+    assert note["kind"] == "code" and note["degraded"] is True
+    assert note["vs_run"] == "best"
+    assert note["normalized_drop_pct"] == pytest.approx(50.0, abs=0.1)
+    assert note["attribution"]["verdict"] == "code_regression"
+
+
+def test_gate_annotates_environment_slowdown_without_degrading():
+    hist = [_entry("best", 155.0, gflops=155.0)]
+    # machine slowed 1.55x and the headline followed: raw drop, cal flat
+    fresh = _entry("fresh", 100.0, gflops=100.0,
+                   value_cal=normalize(100.0, _cal_with(100.0),
+                                       _cal_with(155.0)))
+    note = perfdb.regression_check(fresh, hist)
+    assert note is not None
+    assert note["kind"] == "environment" and note["degraded"] is False
+    assert note["raw_drop_pct"] > perfdb.DROP_TOL * 100
+    assert abs(note["normalized_drop_pct"]) < 1.0
+
+
+def test_gate_ignores_uncalibrated_baseline():
+    """A legacy best (calibration: null) must never certify a code
+    regression — its 'normalized' value is just its raw value, and gating
+    against it re-creates the r05 false positive."""
+    hist = [_entry("r05", 277.5)]  # uncalibrated legacy import
+    fresh = _entry("fresh", 150.0, gflops=100.0)  # would be a 46% "drop"
+    assert perfdb.regression_check(fresh, hist) is None
+    # but a calibrated baseline in the same ledger still gates
+    hist.append(_entry("cal_best", 300.0, gflops=100.0))
+    note = perfdb.regression_check(fresh, hist)
+    assert note is not None and note["vs_run"] == "cal_best"
+
+
+def test_gate_silent_within_tolerance():
+    hist = [_entry("best", 100.0, gflops=100.0)]
+    assert perfdb.regression_check(
+        _entry("fresh", 95.0, gflops=100.0), hist) is None
+    # and with no usable baseline there is nothing to gate against
+    assert perfdb.regression_check(_entry("fresh", 95.0), []) is None
+
+
+# --------------------------------------------------------------------------
+# tools: perf_compare + obs_report --history
+# --------------------------------------------------------------------------
+
+def test_perf_compare_report_sections(tmp_path):
+    from tools.perf_compare import compare
+
+    a = _entry("a", 155.0, gflops=155.0, ts=1000.0)
+    b = _entry("b", 100.0, gflops=100.0, ts=2000.0)
+    for e, ms in ((a, 100.0), (b, 155.0)):
+        e["record"]["all_variants"] = [{
+            "backend": "xla", "dtype": "float32", "step_ms": ms,
+            "phase_time": {"train.step": ms / 1e3 * 5}}]
+    text = compare(a, b)
+    assert "== runs ==" in text
+    assert "verdict: environment" in text
+    assert "== per-variant step time (ms) ==" in text
+    assert "xla:float32:fixed" in text
+    assert "== phase time (s) ==" in text
+    assert "xla:float32:fixed/train.step" in text
+
+
+def test_perf_compare_import_legacy_idempotent(tmp_path, monkeypatch):
+    import tools.perf_compare as pc
+
+    # point the importer at a fake repo root with two archival captures
+    root = tmp_path / "repo"
+    root.mkdir()
+    (root / "BENCH_r01.json").write_text(json.dumps(
+        {"n": 1, "rc": 124, "tail": "timeout", "parsed": None}))
+    (root / "BENCH_r02.json").write_text(json.dumps(
+        {"n": 2, "rc": 0, "parsed": {
+            "metric": perfdb.HEADLINE_METRIC, "value": 227.9,
+            "degraded": True, "all_variants": []}}))
+    monkeypatch.setattr(pc, "HERE", str(root))
+    path = str(root / "history.jsonl")
+    assert pc.import_legacy(path) == ["r01", "r02"]
+    assert pc.import_legacy(path) == []  # idempotent
+    hist = perfdb.load_history(path)
+    assert [e["run_id"] for e in hist] == ["r01", "r02"]
+    r01, r02 = hist
+    assert r01["value"] == 0.0
+    assert r01["degraded_reasons"] == ["no_results"]
+    assert r02["calibration"] is None
+    assert r02["value_cal"] == 227.9  # no calibration → raw == normalized
+    assert r02["degraded_reasons"] == ["no_device"]
+
+
+def test_perf_compare_resolution_and_cli(tmp_path, capsys):
+    import tools.perf_compare as pc
+
+    path = str(tmp_path / "history.jsonl")
+    perfdb.append_entry(path, _entry("run_x", 120.0, gflops=100.0))
+    perfdb.append_entry(path, _entry("run_y", 100.0, gflops=100.0))
+    hist = perfdb.load_history(path)
+    assert pc._resolve(hist, "run_x", None)["run_id"] == "run_x"
+    assert pc._resolve(hist, "-1", None)["run_id"] == "run_y"
+    with pytest.raises(SystemExit):
+        pc._resolve(hist, "nope", None)
+    pc.main(["--history", path])
+    out = capsys.readouterr().out
+    # default compares ledger best (run_x) against newest (run_y)
+    assert "run_x" in out and "run_y" in out
+    assert "code_regression" in out
+
+
+def test_obs_report_history_flag(tmp_path, capsys):
+    from tools.obs_report import main as report_main
+
+    path = str(tmp_path / "history.jsonl")
+    e = _entry("run_z", 100.0, gflops=100.0)
+    e["regression"] = {"kind": "code", "degraded": True}
+    perfdb.append_entry(path, _entry("legacy", 90.0, reasons=["no_device"]))
+    perfdb.append_entry(path, e)
+    report_main(["--history", path])
+    out = capsys.readouterr().out
+    assert "bench trajectory" in out
+    assert "run_z" in out and "legacy" in out
+    assert "[regression:code]" in out
+    assert "no_device" in out

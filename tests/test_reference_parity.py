@@ -20,6 +20,7 @@ we zero PAD lookups; with padding the difference is invisible in outputs at
 real positions only).
 """
 
+import os
 import sys
 import types
 
@@ -32,6 +33,12 @@ import jax  # noqa: E402
 import jax.numpy as jnp  # noqa: E402
 
 REF = "/root/reference"
+
+# Without the reference checkout the module-scoped ``ref`` fixture cannot
+# import anything — skip the whole file instead of erroring at setup.
+pytestmark = pytest.mark.skipif(
+    not os.path.isdir(REF),
+    reason=f"torch reference checkout not present at {REF}")
 
 B, N, TT = 2, 16, 7
 # the reference CSE hard-assumes 8 heads (4 L-heads + 4 T-heads tiling,

@@ -9,27 +9,36 @@ Reads any combination of:
   ``csat_tpu/obs/events.py``) or a Chrome trace-event JSON export
   (``csat_tpu/obs/trace.py``) — and renders a phase-time table
   (count / total / mean / p95 per span name) plus the lifecycle outcome
-  counts found in the event stream.
+  counts found in the event stream;
+* the **perf ledger** (``--history``, ``csat_tpu/obs/perfdb.py``) — the
+  bench trajectory: one row per run with raw and calibration-normalized
+  headline, box fingerprint and degradation flags (ISSUE 10).
 
 Usage::
 
     python tools/obs_report.py --metrics serve_metrics.jsonl \
         --events outputs/postmortem/postmortem_serve_FAILED.jsonl
     python tools/obs_report.py --events outputs/.../host_trace.json
+    python tools/obs_report.py --history results/perf/history.jsonl
 
 Runs on the fast-gate artifacts in CI; ``bench.py`` computes its own
 phase-time breakdown from the recorder's running totals
 (``EventRecorder.totals``) so it needs no artifact round-trip —
-``phase_table`` here is the offline equivalent over a dump/trace file.
+``phase_table`` here is the offline equivalent over a dump/trace file,
+and ``tools/perf_compare.py`` reuses it for its phase-delta section.
 """
 
 from __future__ import annotations
 
 import argparse
 import json
+import os
+import sys
 from typing import Dict, Iterable, List, Optional, Tuple
 
-from csat_tpu.serve.stats import percentile
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from csat_tpu.serve.stats import percentile  # noqa: E402
 
 
 def load_metrics(path: str) -> List[dict]:
@@ -114,8 +123,36 @@ def _fmt_table(rows: List[Tuple], headers: Tuple) -> str:
     return "\n".join([line(headers), sep] + [line(r) for r in rows])
 
 
+def history_table(history: List[dict]) -> str:
+    """The bench trajectory as a table: one row per ledger entry, raw and
+    calibration-normalized headline side by side."""
+    import time as _time
+
+    rows = []
+    for e in history:
+        fp = e.get("machine_fingerprint") or {}
+        cal = e.get("calibration") or {}
+        flags = ",".join(e.get("degraded_reasons") or ()) or "-"
+        if e.get("regression", {}).get("kind"):
+            flags += f" [regression:{e['regression']['kind']}]"
+        rows.append((
+            e.get("run_id", "?"),
+            _time.strftime("%Y-%m-%d", _time.gmtime(e["ts"]))
+            if e.get("ts") else "?",
+            f"{fp.get('platform', '?')}×{fp.get('device_count', '?')}"
+            if fp else "-",
+            e.get("value"),
+            e.get("value_cal"),
+            "yes" if cal.get("probes") else "no",
+            flags,
+        ))
+    return _fmt_table(rows, ("run", "date", "device", "raw", "cal",
+                             "calibrated", "flags"))
+
+
 def report(metrics_path: Optional[str] = None,
-           events_path: Optional[str] = None) -> str:
+           events_path: Optional[str] = None,
+           history_path: Optional[str] = None) -> str:
     """The one-screen report as a string (main() prints it)."""
     sections: List[str] = []
     if metrics_path:
@@ -151,8 +188,19 @@ def report(metrics_path: Optional[str] = None,
                 list(outcomes.items()), ("event", "count")))
         if not phases and not outcomes:
             sections.append(f"(no span or lifecycle events in {events_path})")
+    if history_path:
+        from csat_tpu.obs import perfdb
+
+        history = perfdb.load_history(history_path)
+        if history:
+            sections.append(
+                f"== bench trajectory ({history_path}: {len(history)} "
+                f"run(s)) ==\n" + history_table(history))
+        else:
+            sections.append(f"(no ledger entries in {history_path})")
     if not sections:
-        sections.append("nothing to report: pass --metrics and/or --events")
+        sections.append(
+            "nothing to report: pass --metrics, --events and/or --history")
     return "\n\n".join(sections)
 
 
@@ -162,8 +210,11 @@ def main(argv: Optional[List[str]] = None) -> None:
                    help="metrics JSONL file (MetricsFile format)")
     p.add_argument("--events", default="",
                    help="flight-recorder dump (JSONL) or Chrome trace JSON")
+    p.add_argument("--history", default="",
+                   help="perf ledger JSONL (results/perf/history.jsonl)")
     args = p.parse_args(argv)
-    print(report(args.metrics or None, args.events or None))
+    print(report(args.metrics or None, args.events or None,
+                 args.history or None))
 
 
 if __name__ == "__main__":

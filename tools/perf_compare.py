@@ -1,0 +1,256 @@
+"""Diff two bench runs from the perf ledger and attribute the delta (ISSUE 10).
+
+Reads ``results/perf/history.jsonl`` (``csat_tpu/obs/perfdb.py`` — every
+``bench.py`` run appends its full record, calibration block and machine
+fingerprint) and renders a one-screen comparison in the same table style as
+``tools/obs_report.py``:
+
+* run header — id, date, host/device fingerprint, matmul-probe GFLOP/s;
+* headline — raw and calibration-normalized values plus the
+  ``{environment, code, unexplained}`` attribution of the delta (the
+  automated version of the interleaved A/B the r05→r08 episode needed by
+  hand); legacy entries imported with ``calibration: null`` attribute to
+  ``unexplained`` — unattributable, said out loud;
+* per-variant step-time deltas;
+* phase-time deltas (``phase_time{}`` from the records, aggregated through
+  ``tools/obs_report.py:phase_table``).
+
+Usage::
+
+    python tools/perf_compare.py                 # ledger best vs newest run
+    python tools/perf_compare.py --a run_X --b run_Y
+    python tools/perf_compare.py --a -2 --b -1   # by ledger index
+    python tools/perf_compare.py --import-legacy # backfill BENCH_r01..r05
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import sys
+import time
+from typing import List, Optional, Tuple
+
+HERE = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, HERE)
+
+from csat_tpu.obs import perfdb  # noqa: E402
+from tools.obs_report import _fmt_table, phase_table  # noqa: E402
+
+
+def default_history_path() -> str:
+    p = os.environ.get("BENCH_HISTORY_FILE")
+    if p is None:
+        try:
+            from csat_tpu.configs import get_config
+
+            p = get_config("python").bench_history_file
+        except Exception:  # noqa: BLE001
+            p = "results/perf/history.jsonl"
+    return p if (not p or os.path.isabs(p)) else os.path.join(HERE, p)
+
+
+# --------------------------------------------------------------------------
+# legacy backfill
+# --------------------------------------------------------------------------
+
+def import_legacy(history_path: str, pattern: str = "BENCH_r0*.json") -> List[str]:
+    """One-shot backfill: fold the archival ``BENCH_r01..r05.json`` driver
+    captures into the ledger with ``calibration: null`` so the trajectory
+    table is not empty on day one.  Idempotent — run_ids already present
+    are skipped.  Returns the run_ids appended."""
+    have = {e.get("run_id") for e in perfdb.load_history(history_path)}
+    appended: List[str] = []
+    for path in sorted(glob.glob(os.path.join(HERE, pattern))):
+        run_id = os.path.splitext(os.path.basename(path))[0].split("_")[-1].lower()
+        if run_id in have:
+            continue
+        try:
+            with open(path) as f:
+                raw = json.load(f)
+        except (OSError, ValueError):
+            continue
+        parsed = raw.get("parsed") or {}
+        bench_out = dict(parsed)
+        bench_out.setdefault("metric", perfdb.HEADLINE_METRIC)
+        bench_out.setdefault("value", 0.0)
+        bench_out["calibration"] = None
+        bench_out["machine_fingerprint"] = None
+        # no calibration: raw == normalized, by definition of the ratio
+        bench_out["nodes_per_sec_per_chip_cal"] = bench_out["value"]
+        reasons = []
+        if not parsed:
+            reasons.append("no_results")
+            bench_out["notes"] = (
+                f"legacy import: driver capture rc={raw.get('rc')} had no "
+                f"parseable bench line")
+        elif parsed.get("degraded"):
+            reasons.append("no_device")
+        bench_out["degraded_reasons"] = reasons
+        entry = perfdb.make_entry(
+            bench_out, run_id=run_id, ts=os.path.getmtime(path),
+            source=os.path.basename(path))
+        perfdb.append_entry(history_path, entry)
+        appended.append(run_id)
+    return appended
+
+
+# --------------------------------------------------------------------------
+# comparison rendering
+# --------------------------------------------------------------------------
+
+def _resolve(history: List[dict], sel: Optional[str],
+             fallback: Optional[dict]) -> Optional[dict]:
+    """A ledger entry by run_id, by (possibly negative) index, or the
+    fallback when no selector was given."""
+    if sel is None or sel == "":
+        return fallback
+    for e in history:
+        if e.get("run_id") == sel:
+            return e
+    try:
+        return history[int(sel)]
+    except (ValueError, IndexError):
+        raise SystemExit(
+            f"no ledger entry {sel!r} (have "
+            f"{[e.get('run_id') for e in history]})")
+
+
+def _when(e: dict) -> str:
+    ts = e.get("ts")
+    if not ts:
+        return "?"
+    return time.strftime("%Y-%m-%d %H:%M", time.gmtime(ts))
+
+
+def _fp_line(e: dict) -> Tuple[str, str, str]:
+    fp = e.get("machine_fingerprint") or {}
+    cal = e.get("calibration") or {}
+    probes = cal.get("probes") or {}
+    mm = probes.get("matmul_f32_gflops")
+    return (
+        f"{fp.get('host', '?')}/{fp.get('platform', '?')}"
+        f"×{fp.get('device_count', '?')}",
+        fp.get("id", "-"),
+        f"{mm:.1f}" if isinstance(mm, (int, float)) else "-",
+    )
+
+
+def _variants(e: dict) -> List[dict]:
+    return (e.get("record") or {}).get("all_variants") or []
+
+
+def _vkey(v: dict) -> str:
+    return f"{v.get('backend')}:{v.get('dtype')}:{v.get('mode', 'fixed')}"
+
+
+def _phase_events(e: dict) -> List[dict]:
+    """Pseudo span events from each variant's ``phase_time{}`` block, so
+    :func:`tools.obs_report.phase_table` can aggregate them exactly like a
+    flight-recorder dump."""
+    events = []
+    for v in _variants(e):
+        for name, dur in (v.get("phase_time") or {}).items():
+            events.append({"name": f"{_vkey(v)}/{name}", "dur": float(dur)})
+    return events
+
+
+def _pct(new: float, old: float) -> str:
+    if not old:
+        return "-"
+    return f"{(new / old - 1.0) * 100.0:+.1f}%"
+
+
+def compare(a: dict, b: dict) -> str:
+    """The one-screen comparison report (``a`` = baseline, ``b`` = candidate)."""
+    sections: List[str] = []
+    rows = []
+    for tag, e in (("a (base)", a), ("b (new)", b)):
+        box, fpid, mm = _fp_line(e)
+        rows.append((tag, e.get("run_id"), _when(e), box, fpid, mm,
+                     e.get("value"), e.get("value_cal"),
+                     ",".join(e.get("degraded_reasons") or ()) or "-"))
+    sections.append("== runs ==\n" + _fmt_table(
+        rows, ("", "run", "when (utc)", "box", "fp", "matmul_gflops",
+               "raw", "cal", "degraded")))
+
+    att = perfdb.attribute_delta(a, b)
+    if not att.get("comparable"):
+        sections.append(f"headline not comparable: {att.get('why')}")
+    else:
+        rows = [
+            ("total", f"{att['total_pct']:+.2f}%",
+             "raw headline delta (b vs a)"),
+            ("environment", f"{att['environment_pct']:+.2f}%",
+             "machine-speed delta per the calibration probes"),
+            ("code", f"{att['code_pct']:+.2f}%",
+             "residual beyond the noise tolerance "
+             f"(±{att['noise_tol_pct']}%)"),
+            ("unexplained", f"{att['unexplained_pct']:+.2f}%",
+             "residual within noise"
+             if att["calibrated"] else
+             "whole residual — a side lacks calibration"),
+        ]
+        sections.append(
+            f"== headline attribution — verdict: {att['verdict']} ==\n"
+            + _fmt_table(rows, ("component", "delta", "meaning")))
+
+    va = {_vkey(v): v for v in _variants(a)}
+    vb = {_vkey(v): v for v in _variants(b)}
+    common = [k for k in va if k in vb]
+    if common:
+        rows = []
+        for k in common:
+            sa, sb = va[k].get("step_ms"), vb[k].get("step_ms")
+            rows.append((k, sa, sb,
+                         _pct(sb, sa) if sa and sb else "-"))
+        sections.append("== per-variant step time (ms) ==\n" + _fmt_table(
+            rows, ("variant", "a", "b", "delta")))
+
+    pa, pb = phase_table(_phase_events(a)), phase_table(_phase_events(b))
+    shared = [n for n in pa if n in pb]
+    if shared:
+        rows = [(n, pa[n]["total_s"], pb[n]["total_s"],
+                 _pct(pb[n]["total_s"], pa[n]["total_s"]))
+                for n in shared]
+        sections.append("== phase time (s) ==\n" + _fmt_table(
+            rows, ("phase", "a", "b", "delta")))
+    return "\n\n".join(sections)
+
+
+def main(argv: Optional[List[str]] = None) -> None:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--history", default="",
+                   help="ledger path (default: the bench_history_file knob)")
+    p.add_argument("--a", default="",
+                   help="baseline entry: run_id or index (default: ledger best)")
+    p.add_argument("--b", default="",
+                   help="candidate entry: run_id or index (default: newest)")
+    p.add_argument("--import-legacy", action="store_true",
+                   help="backfill BENCH_r01..r05.json into the ledger "
+                        "(calibration: null), then exit")
+    args = p.parse_args(argv)
+    path = args.history or default_history_path()
+    if args.import_legacy:
+        added = import_legacy(path)
+        print(f"imported {len(added)} legacy record(s) into {path}: "
+              f"{', '.join(added) or '(none — already present)'}")
+        return
+    history = perfdb.load_history(path)
+    if not history:
+        raise SystemExit(
+            f"empty ledger {path} — run bench.py (or --import-legacy) first")
+    b = _resolve(history, args.b, perfdb.last_entry(history))
+    best = perfdb.best_entry(history)
+    a = _resolve(history, args.a,
+                 best if (best is not None and best is not b)
+                 else (history[-2] if len(history) > 1 else history[0]))
+    if a is None or b is None:
+        raise SystemExit("could not resolve two entries to compare")
+    print(compare(a, b))
+
+
+if __name__ == "__main__":
+    main()
