@@ -311,6 +311,9 @@ def _measure_one(spec: str, heartbeat=None) -> dict:
     if mode == "serve":
         # batch field = slot-pool size, steps field = request count
         return _measure_serve(backend, dtype, batch_size, n_steps, heartbeat)
+    if mode == "fleet":
+        # batch field = slots PER REPLICA, steps field = request count
+        return _measure_fleet(backend, dtype, batch_size, n_steps, heartbeat)
     import jax
     import numpy as np
 
@@ -857,6 +860,221 @@ def _measure_serve(backend: str, dtype: str, num_slots: int,
     return rec
 
 
+def _measure_fleet(backend: str, dtype: str, num_slots: int,
+                   n_requests: int, heartbeat=None) -> dict:
+    """Replica-fleet serving (``csat_tpu/serve/fleet.py``) vs a solo
+    engine, over the SAME Poisson request trace — plus the ISSUE 11
+    sick-replica drill.
+
+    Protocol: the PR-7 skewed-length / skewed-budget trace runs twice at
+    identical per-replica geometry (``num_slots`` slots each):
+
+    * **solo** — one fault-free ``ServeEngine``; its outputs are the
+      bit-identity reference and its tps the N=1 yardstick;
+    * **fleet** — N=2 replicas behind the health-aware router, with a
+      rebuild-cap fault (``FaultInjector`` decode faults +
+      ``serve_max_rebuilds=0``) injected on replica 1 at the trace
+      midpoint.  The drill's claims, recorded per run: the fleet keeps
+      serving at ``capacity_frac == (N-1)/N``, drain leaves ZERO
+      non-terminal requests, and every request the healthy replicas
+      finish is bit-identical to the solo run.
+
+    Bit-identity needs a decode that is a pure function of (sample,
+    budget): the fleet config pins ``full_att`` + zero dropout (the same
+    paths the serve exactness tests pin) and ONE prefill bucket at the
+    flagship width — which also bounds the 3-engine compile bill (the
+    persistent compilation cache dedups identical programs across the
+    solo engine and both replicas).
+    """
+    import jax
+    import numpy as np
+
+    from csat_tpu.configs import get_config
+    from csat_tpu.data.toy import random_request_sample
+    from csat_tpu.resilience.faults import FaultInjector
+    from csat_tpu.serve.engine import RequestStatus, ServeEngine
+    from csat_tpu.serve.fleet import Fleet
+    from csat_tpu.serve.prefill import collate_requests
+    from csat_tpu.serve.router import HEALTHY
+
+    replicas = 2
+    overrides = dict(backend=backend, compute_dtype=dtype, prefetch=0,
+                     serve_slots=num_slots,
+                     # bit-identity paths (serve exactness-test config) +
+                     # first decode fault exhausts the rebuild cap
+                     full_att=True, dropout=0.0, attention_dropout=0.0,
+                     cse_empty_rows="zero", serve_max_rebuilds=0)
+    if backend == "pallas":
+        overrides["noise_mode"] = "counter"
+    probe = get_config("python", **overrides)
+    overrides["bucket_src_lens"] = (probe.max_src_len,)
+    cfg = get_config("python", **overrides)
+    src_v, tgt_v, trip_v = 10_000, 20_000, 1246
+    steps = cfg.max_tgt_len - 1
+    rng = np.random.default_rng(3)
+    lengths = _skewed_lengths(rng, n_requests, cfg.max_src_len)
+    budgets = np.clip(
+        (steps * rng.lognormal(mean=-1.0, sigma=0.5, size=n_requests)).astype(int),
+        2, steps)
+    samples = [
+        random_request_sample(cfg, src_v, trip_v, int(lengths[i]), seed=300 + i)
+        for i in range(n_requests)
+    ]
+
+    from csat_tpu.train.state import create_train_state, default_optimizer, make_model
+
+    model = make_model(cfg, src_v, tgt_v, trip_v)
+    tx = default_optimizer(cfg)
+    warm = collate_requests(samples[:1], cfg.max_src_len, num_slots, cfg,
+                            tgt_width=steps)
+    params = create_train_state(model, tx, warm, seed=cfg.seed).params
+
+    def run_trace(target, n_engines: int, drill=None):
+        """Drive the Poisson trace against an engine-shaped target (solo
+        engine or fleet); arrivals are in TICK units scaled to the
+        target's service rate so both phases see saturating load."""
+        arrivals = np.cumsum(rng2.exponential(
+            scale=float(budgets.mean()) / max(num_slots * n_engines, 1) / 1.4,
+            size=n_requests))
+        t0 = time.perf_counter()
+        step_clock, nxt, ids = 0, 0, []
+        while nxt < n_requests or target.occupancy or target.queue_depth:
+            while nxt < n_requests and arrivals[nxt] <= step_clock:
+                ids.append(target.submit(samples[nxt],
+                                         max_new_tokens=int(budgets[nxt])))
+                nxt += 1
+            if drill is not None and nxt >= n_requests // 2:
+                drill()
+                drill = None
+            live = target.tick()
+            step_clock += 1
+            if not live and not target.queue_depth and nxt < n_requests:
+                step_clock = max(step_clock, int(np.ceil(arrivals[nxt])))
+        wall = time.perf_counter() - t0
+        return wall, [target.poll(i) for i in ids]
+
+    # ---- solo reference: one fault-free engine ---------------------------
+    t_compile = time.perf_counter()
+    solo = ServeEngine(model, params, cfg, sample_seed=1)
+    solo.generate(
+        [random_request_sample(cfg, src_v, trip_v, spec.n, seed=30 + i)
+         for i, spec in enumerate(solo.specs)],
+        max_new_tokens=2)
+    solo_compiles = solo.stats.compiles
+    t_compile = time.perf_counter() - t_compile
+    rng2 = np.random.default_rng(4)
+    solo.reset_stats()
+    solo_wall, solo_reqs = run_trace(solo, 1)
+    assert solo.stats.compiles == solo_compiles, "steady-state recompile!"
+    solo.close()
+    solo_useful = sum(r.n_tokens for r in solo_reqs)
+
+    # ---- fleet run with the mid-trace sick-replica drill -----------------
+    t0c = time.perf_counter()
+    fleet = Fleet(model, params, cfg, replicas=replicas, sample_seed=1)
+    # warm every replica's prefill bucket + decode program: the JSQ router
+    # alternates equal-load submissions, so `replicas` copies of each
+    # bucket-capacity request land one per replica
+    fleet.generate(
+        [random_request_sample(cfg, src_v, trip_v, spec.n, seed=30 + i)
+         for i, spec in enumerate(fleet.replicas[0].engine.specs)
+         for _ in range(replicas)],
+        max_new_tokens=2)
+    compiles_warm = [r.engine.stats.compiles for r in fleet.replicas]
+    t_compile += time.perf_counter() - t0c
+    if heartbeat is not None:
+        heartbeat({"phase": "compiled", "compile_s": round(t_compile, 1),
+                   "programs": int(sum(compiles_warm)) + solo_compiles})
+
+    def drill() -> None:
+        # decode faults on replica 1 from its next tick on; with
+        # serve_max_rebuilds=0 the first one exhausts the rebuild cap and
+        # the fleet retires the replica. fleet.ticks == each live
+        # engine's next tick ordinal (engines tick in lockstep)
+        fleet.replicas[1].engine.fault_injector = FaultInjector(
+            serve_decode_fail_ticks=frozenset(
+                range(fleet.ticks, fleet.ticks + 10_000)))
+
+    rng2 = np.random.default_rng(4)
+    fleet_wall, fleet_reqs = run_trace(fleet, replicas, drill=drill)
+    useful = sum(r.n_tokens for r in fleet_reqs if r is not None)
+    nonterminal = sum(1 for r in fleet_reqs
+                      if r is None or r.status not in RequestStatus.TERMINAL)
+    sick = [r.index for r in fleet.replicas if r.health != HEALTHY]
+    # zero steady-state recompiles on the SURVIVING replicas (resubmitted
+    # requests reuse the warmed bucket programs)
+    for rep in fleet.replicas:
+        if rep.health == HEALTHY:
+            assert rep.engine.stats.compiles == compiles_warm[rep.index], (
+                f"steady-state recompile on replica {rep.index}")
+    # healthy-replica outputs bit-identical to the fault-free solo run
+    compared = mismatches = 0
+    for req, ref in zip(fleet_reqs, solo_reqs):
+        if (req is not None and req.status == RequestStatus.OK
+                and ref.status == RequestStatus.OK):
+            compared += 1
+            if (req.n_tokens != ref.n_tokens or not np.array_equal(
+                    np.asarray(req.tokens), np.asarray(ref.tokens))):
+                mismatches += 1
+    summ = fleet.summary(wall_s=fleet_wall, n_chips=1)
+    fleet.close()
+
+    n_chips = jax.device_count()
+    tps = useful / fleet_wall / n_chips
+    solo_tps = solo_useful / solo_wall / n_chips
+    per_replica = [
+        {k: p[k] for k in ("replica", "health", "sick_reason", "num_slots",
+                           "submitted", "retired", "shed", "failed",
+                           "gen_tokens", "compiles", "latency_p95_s")}
+        for p in summ["per_replica"]
+    ]
+    rec = {
+        "ok": True,
+        "backend": backend,
+        "dtype": dtype,
+        "mode": "fleet",
+        "noise_mode": cfg.noise_mode,
+        "device": jax.devices()[0].platform,
+        "n_chips": n_chips,
+        "loss": 0.0,
+        "compile_s": round(t_compile, 1),
+        "steps": int(summ["decode_steps"]),
+        "step_ms": round(fleet_wall / max(summ["decode_steps"], 1) * 1e3, 2),
+        "num_slots": num_slots,          # per replica
+        "engine_slots": fleet.num_slots,  # fleet total
+        "replicas": replicas,
+        "requests": n_requests,
+        "programs": int(sum(compiles_warm)),
+        "gen_tokens": useful,
+        # the shared serving headline + the fleet-specific aliases
+        "gen_tokens_per_sec_per_chip": round(tps, 2),
+        "fleet_tps_per_chip": round(tps, 2),
+        "solo_tps_per_chip": round(solo_tps, 2),
+        "vs_solo": round(tps / solo_tps, 3) if solo_tps > 0 else 0.0,
+        # ---- sick-replica drill evidence (ISSUE 11 acceptance) ----
+        "capacity_frac": summ["capacity_frac"],
+        "sick_replicas": sick,
+        "sick_reason": next((r.sick_reason for r in fleet.replicas
+                             if r.sick_reason), None),
+        "nonterminal_after_drain": nonterminal,
+        "sick_replica_bit_identical": bool(compared) and mismatches == 0,
+        "bit_identical_requests": compared,
+        "resubmissions": summ["resubmissions"],
+        "latency_p50_s": summ["latency_p50_s"],
+        "latency_p95_s": summ["latency_p95_s"],
+        "per_replica": per_replica,
+        "req_failed": summ["failed"],
+        "req_timeouts": summ["timeouts"],
+        "req_rejected": summ["rejected"] + summ["shed"],
+        "pool_rebuilds": summ["rebuilds"],
+        # keep the shared-record contract so the variant table renders
+        "nodes_per_sec_per_chip": 0.0,
+        "real_nodes_per_sec_per_chip": 0.0,
+    }
+    _record_variant_metrics(rec, t_compile)
+    return rec
+
+
 def _serve(specs_csv: str, soft_budget_s: float) -> None:
     """Measure every spec inside ONE backend session / chip claim.
 
@@ -1172,6 +1390,11 @@ def main() -> None:
             "pallas:bfloat16:default:64:20",
             "xla:float32:default:64:20:bucketed",
             "xla:float32:default:16:64:serve",
+            # replica fleet LAST: 3 engines' compiles make it the most
+            # expensive variant, so soft-budget exhaustion skips it
+            # without starving the proven specs (batch field = slots per
+            # replica, steps field = request count)
+            "xla:float32:default:8:32:fleet",
         ]
     else:
         # honest CPU comparison: f32 at batch 6 — both frameworks' measured
@@ -1188,6 +1411,9 @@ def main() -> None:
             "pallas:float32:cpu:4:5",
             "xla:float32:cpu:6:4:bucketed",
             "xla:float32:cpu:4:10:serve",
+            # replica-fleet mode last (2 slots per replica, 8-request trace
+            # with the mid-trace sick-replica drill) — see _measure_fleet
+            "xla:float32:cpu:2:8:fleet",
         ]
 
     # -- phase 2: one serve child per platform group (one chip claim for all
@@ -1350,7 +1576,8 @@ def main() -> None:
         # still appear in all_variants with their own numbers)
         real = [r for r in results
                 if not (r["device"] == "cpu" and r["backend"] == "pallas")
-                and r.get("mode", "fixed") not in ("bucketed", "serve")]
+                and r.get("mode", "fixed") not in ("bucketed", "serve",
+                                                   "fleet")]
         pool = real or results
         best = max(pool, key=lambda r: r["nodes_per_sec_per_chip"])
         value = best["nodes_per_sec_per_chip"]
@@ -1409,7 +1636,16 @@ def main() -> None:
                                      "mask_density_per_layer", "parity",
                                      "attention_trace_file", "compile_s",
                                      "compile_s_per_bucket", "peak_bytes",
-                                     "peak_bytes_source")
+                                     "peak_bytes_source",
+                                     # replica-fleet mode (ISSUE 11)
+                                     "replicas", "fleet_tps_per_chip",
+                                     "solo_tps_per_chip", "vs_solo",
+                                     "capacity_frac", "per_replica",
+                                     "sick_replicas", "sick_reason",
+                                     "nonterminal_after_drain",
+                                     "sick_replica_bit_identical",
+                                     "bit_identical_requests",
+                                     "resubmissions")
                    if k in r}
             # self-describing artifact (r4 verdict weak #6): pallas on CPU is
             # pl.pallas_call(interpret=True) — a correctness canary, not a

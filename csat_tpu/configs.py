@@ -238,6 +238,23 @@ class Config:
     # limit + this many extra ticks is frozen and resolved FAILED instead
     # of wedging drain() forever
     serve_reap_margin: int = 4
+    # --- replica fleet (csat_tpu/serve/fleet.py) ---
+    # engine replicas behind the health-aware router; each replica owns its
+    # own KV page pool, program cache, queue, fault budget and metrics
+    # registry. 1 = single engine (the fleet layer is bypassed by the CLI)
+    serve_replicas: int = 1
+    # fleet-wide admission bound across all HEALTHY replicas' queues;
+    # 0 = derive from the per-replica bound (serve_max_queue x healthy
+    # replicas — shrinks as replicas sicken, so a degraded fleet sheds
+    # earlier instead of queueing work it cannot serve). The policy at the
+    # bound reuses serve_queue_policy verbatim: "reject" the new request,
+    # or "shed_oldest" from the deepest healthy queue
+    serve_fleet_max_queue: int = 0
+    # reap-storm health trip: a replica whose reaped-request count reaches
+    # this moves to SICK and is retired (its work resubmitted to healthy
+    # replicas) — stuck slots at this rate mean the replica, not the
+    # requests. 0 = off (rebuild-cap and watchdog trips still retire)
+    serve_fleet_reap_storm: int = 0
     # --- training resilience follow-ups (ROADMAP) ---
     # device-side liveness probe on the step watchdog: a tiny chained
     # collective heartbeat runs on its own thread; if the device stops
@@ -449,6 +466,9 @@ class Config:
         assert self.serve_max_rebuilds >= 0, self.serve_max_rebuilds
         assert self.serve_max_retries >= 0, self.serve_max_retries
         assert self.serve_reap_margin >= 1, self.serve_reap_margin
+        assert self.serve_replicas >= 1, self.serve_replicas
+        assert self.serve_fleet_max_queue >= 0, self.serve_fleet_max_queue
+        assert self.serve_fleet_reap_storm >= 0, self.serve_fleet_reap_storm
         assert self.snapshot_every_steps >= 0, self.snapshot_every_steps
         assert self.obs_events >= 0, self.obs_events
         assert self.obs_metrics_every_s > 0, self.obs_metrics_every_s

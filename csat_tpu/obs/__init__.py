@@ -38,6 +38,7 @@ from csat_tpu.obs.metrics import (  # noqa: F401
     Histogram,
     MetricsFile,
     MetricsRegistry,
+    merge_histograms,
 )
 from csat_tpu.obs.trace import (  # noqa: F401
     load_chrome_trace,

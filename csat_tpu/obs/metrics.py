@@ -28,7 +28,7 @@ from typing import Callable, Dict, List, Optional, Sequence, Tuple, Union
 
 __all__ = [
     "Counter", "Gauge", "Histogram", "MetricsRegistry", "MetricsFile",
-    "DEFAULT_BUCKETS",
+    "DEFAULT_BUCKETS", "merge_histograms",
 ]
 
 _NAME_RE = re.compile(r"^[a-zA-Z_:][a-zA-Z0-9_:]*$")
@@ -122,6 +122,24 @@ class Histogram:
         out.append((f"{self.name}_count", self.count))
         return out
 
+    def quantile(self, q: float) -> float:
+        """Nearest-rank quantile estimate from the bucket counts: the upper
+        bound of the bucket holding rank ``ceil(q/100 * count)`` (overflow
+        observations report the last finite bound).  Coarser than the
+        windowed exact percentiles in ``ServeStats.summary`` but — unlike
+        percentiles — histograms MERGE across replicas, so this is the
+        fleet-correct aggregate (``q`` in percent, matching
+        ``serve.stats.percentile``).  0.0 on an empty histogram."""
+        if not self.count:
+            return 0.0
+        rank = max(1, -(-int(q) * self.count // 100))  # ceil without float
+        cum = 0
+        for le, c in zip(self.buckets, self.counts):
+            cum += c
+            if cum >= rank:
+                return float(le)
+        return float(self.buckets[-1])
+
 
 class MetricsRegistry:
     """Get-or-create registry keyed by metric name (registration order is
@@ -155,29 +173,68 @@ class MetricsRegistry:
     def __iter__(self):
         return iter(self._metrics.values())
 
-    def prometheus(self) -> str:
-        """Prometheus text exposition (version 0.0.4)."""
+    def prometheus(self, labels: Optional[Dict[str, str]] = None,
+                   prefix: str = "") -> str:
+        """Prometheus text exposition (version 0.0.4).
+
+        ``labels`` are injected into every sample (merged into the existing
+        ``{le=...}`` braces on histogram buckets) — how a fleet scrapes N
+        identical per-replica registries under ``replica="k"`` without the
+        series colliding.  ``prefix`` prepends to every metric name."""
+        assert not prefix or _NAME_RE.match(prefix), f"bad prefix {prefix!r}"
+        lbl = ",".join(f'{k}="{v}"' for k, v in (labels or {}).items())
         lines: List[str] = []
         for m in self._metrics.values():
             if m.help:
-                lines.append(f"# HELP {m.name} {m.help}")
-            lines.append(f"# TYPE {m.name} {m.kind}")
+                lines.append(f"# HELP {prefix}{m.name} {m.help}")
+            lines.append(f"# TYPE {prefix}{m.name} {m.kind}")
             for sample, value in m.samples():
+                sample = prefix + sample
+                if lbl:
+                    if "{" in sample:
+                        head, rest = sample.split("{", 1)
+                        sample = f"{head}{{{lbl},{rest}"
+                    else:
+                        sample = f"{sample}{{{lbl}}}"
                 lines.append(f"{sample} {_fmt(value)}")
         return "\n".join(lines) + "\n"
 
-    def snapshot(self) -> Dict[str, float]:
+    def snapshot(self, prefix: str = "") -> Dict[str, float]:
         """Flat name→value dict (histograms contribute ``_sum``/``_count``
-        only — buckets stay a Prometheus concern) for JSONL streaming."""
+        only — buckets stay a Prometheus concern) for JSONL streaming.
+        ``prefix`` namespaces the keys (per-replica fleet snapshots)."""
         out: Dict[str, float] = {}
         for m in self._metrics.values():
             if isinstance(m, Histogram):
-                out[f"{m.name}_sum"] = round(m.sum, 6)
-                out[f"{m.name}_count"] = m.count
+                out[f"{prefix}{m.name}_sum"] = round(m.sum, 6)
+                out[f"{prefix}{m.name}_count"] = m.count
             else:
                 v = m.value
-                out[m.name] = round(v, 6) if isinstance(v, float) else v
+                out[f"{prefix}{m.name}"] = (
+                    round(v, 6) if isinstance(v, float) else v)
         return out
+
+
+def merge_histograms(hists: Sequence[Histogram], name: str = "",
+                     help: str = "") -> Histogram:
+    """One histogram whose buckets/counts/sum are the element-wise sum of
+    ``hists`` (which must share identical bucket bounds) — the correct way
+    to aggregate latency across fleet replicas: quantiles of the MERGED
+    distribution, never an average of per-replica percentiles (averaging
+    p95s underweights the replica actually taking the traffic)."""
+    hists = list(hists)
+    assert hists, "merge_histograms needs at least one histogram"
+    buckets = hists[0].buckets
+    for h in hists[1:]:
+        assert h.buckets == buckets, (
+            f"bucket mismatch: {h.name} {h.buckets} vs {buckets}")
+    out = Histogram(name or hists[0].name, help or hists[0].help, buckets)
+    for h in hists:
+        for i, c in enumerate(h.counts):
+            out.counts[i] += c
+        out.sum += h.sum
+        out.count += h.count
+    return out
 
 
 class MetricsFile:

@@ -23,6 +23,7 @@ from csat_tpu.serve.engine import (  # noqa: F401
     RequestStatus,
     ServeEngine,
 )
+from csat_tpu.serve.fleet import Fleet, Replica  # noqa: F401
 from csat_tpu.serve.ingest import (  # noqa: F401
     PoisonRequestError,
     sample_from_dataset,
@@ -47,5 +48,6 @@ from csat_tpu.serve.prefill import (  # noqa: F401
     prefill_plan,
 )
 from csat_tpu.serve.prefix import PrefixCache, sample_hash  # noqa: F401
+from csat_tpu.serve.router import DRAINING, HEALTHY, SICK, Router  # noqa: F401
 from csat_tpu.serve.slots import SlotPool, build_decode_step, init_pool  # noqa: F401
 from csat_tpu.serve.stats import ServeStats, percentile  # noqa: F401

@@ -49,6 +49,11 @@ def _parser() -> argparse.ArgumentParser:
                    help="orbax params dir (default: the config's output dir)")
     p.add_argument("--serve_slots", type=int, default=0,
                    help="decode-slot pool size (default: config serve_slots)")
+    p.add_argument("--replicas", type=int, default=0,
+                   help="engine replicas behind the health-aware router "
+                        "(serve/fleet.py) — each owns its own KV pool, "
+                        "program cache, queue and fault budget; 1 = single "
+                        "engine (default: config serve_replicas)")
     p.add_argument("--kv_layout", default="",
                    help="paged | rect KV-cache layout (default: config "
                         "serve_kv_layout)")
@@ -124,6 +129,8 @@ def build_engine(args):
         overrides["data_dir"] = args.data_dir
     if args.serve_slots:
         overrides["serve_slots"] = args.serve_slots
+    if getattr(args, "replicas", 0):
+        overrides["serve_replicas"] = args.replicas
     if getattr(args, "max_queue", -1) >= 0:
         overrides["serve_max_queue"] = args.max_queue
     if getattr(args, "queue_policy", ""):
@@ -157,9 +164,26 @@ def build_engine(args):
     ckpt = args.checkpoint_dir or os.path.join(
         cfg.output_dir, cfg.project_name, cfg.task_name)
     params = restore_params(ckpt)
-    engine = ServeEngine(model, params, cfg, tgt_vocab=tgt_vocab,
-                         log=lambda m: print(m, file=sys.stderr))
+    log = lambda m: print(m, file=sys.stderr)  # noqa: E731
+    if cfg.serve_replicas > 1:
+        from csat_tpu.serve.fleet import Fleet
+
+        engine = Fleet(model, params, cfg, tgt_vocab=tgt_vocab, log=log)
+    else:
+        engine = ServeEngine(model, params, cfg, tgt_vocab=tgt_vocab, log=log)
     return engine, cfg, src_vocab, trip_vocab
+
+
+def _is_fleet(engine) -> bool:
+    return hasattr(engine, "replicas")
+
+
+def _summary(engine, n_chips: int) -> dict:
+    """Engine-or-fleet stats summary (the fleet aggregates per-replica
+    counters and merged-histogram latency quantiles itself)."""
+    if _is_fleet(engine):
+        return engine.summary(n_chips=n_chips)
+    return engine.stats.summary(n_chips=n_chips)
 
 
 def _telemetry(engine, cfg, args):
@@ -170,9 +194,12 @@ def _telemetry(engine, cfg, args):
 
     writer = None
     if cfg.obs_metrics_file:
-        # registry looked up per write: reset_stats swaps the stats object
-        writer = MetricsFile(cfg.obs_metrics_file,
-                             lambda: engine.stats.registry,
+        # registry looked up per write: reset_stats swaps the stats object.
+        # A fleet IS its own snapshot source — fleet-level series plus
+        # every replica's registry under a replica<k>_ key prefix
+        source = ((lambda: engine) if _is_fleet(engine)
+                  else (lambda: engine.stats.registry))
+        writer = MetricsFile(cfg.obs_metrics_file, source,
                              every_s=cfg.obs_metrics_every_s)
 
     def extra():
@@ -243,8 +270,7 @@ def _summarize(args) -> None:
     finalize()
     import jax
 
-    print(json.dumps(engine.stats.summary(n_chips=jax.device_count())),
-          file=sys.stderr)
+    print(json.dumps(_summary(engine, jax.device_count())), file=sys.stderr)
 
 
 def _parse_request(line: str, n_anon: int):
@@ -413,15 +439,14 @@ def _serve(args) -> None:
                 writer.maybe_write(extra=extra())
             if hb_every and engine.clock() - last_hb >= hb_every:
                 last_hb = engine.clock()
-                s = engine.stats.summary(n_chips=n_chips)
+                s = _summary(engine, n_chips)
                 hb = {k: s[k] for k in hb_keys}
                 hb.update(queue_depth=engine.queue_depth,
                           occupancy=engine.occupancy)
                 print(f"# heartbeat {json.dumps(hb)}", file=sys.stderr)
     engine.close()
     finalize()
-    print(json.dumps(engine.stats.summary(n_chips=n_chips)),
-          file=sys.stderr)
+    print(json.dumps(_summary(engine, n_chips)), file=sys.stderr)
 
 
 def main(argv: Optional[List[str]] = None) -> None:

@@ -323,13 +323,22 @@ class ServeEngine:
                 on_timeout=watchdog_on_timeout,
                 on_trip=self._watchdog_trip,
                 log=log).start()
+        self._closed = False
 
-    def close(self) -> None:
-        """Stop background machinery (the watchdog thread). Idempotent."""
+    def close(self) -> bool:
+        """Stop background machinery (the watchdog thread) and flush any
+        pending post-mortem dumps.  Idempotent: the first call returns True,
+        later calls are no-ops returning False — so a fleet that retires a
+        replica and later sweeps ``close()`` over every replica cannot
+        double-dump post-mortems."""
+        if self._closed:
+            return False
+        self._closed = True
         if self._watchdog is not None:
             self._watchdog.stop()
             self._watchdog = None
         self._flush_postmortems(force=True)
+        return True
 
     # ---------------- observability plumbing ----------------
 
@@ -576,6 +585,19 @@ class ServeEngine:
             self._watchdog.disarm()
         self._flush_postmortems(force=True)
         return n
+
+    def shed_oldest(self, reason: str = "shed by admission control") -> Optional[Request]:
+        """Shed the QUEUED request at the head of the FIFO (terminal SHED,
+        no tokens — it was never admitted to a slot); None when nothing is
+        queued.  The public hook fleet-level admission control layers over
+        per-replica queues: the router sheds from the deepest queue without
+        reaching into engine internals."""
+        if not self._queue:
+            return None
+        req = self._queue.popleft()
+        self._finish(req, RequestStatus.SHED, error=reason)
+        self._flush_postmortems()
+        return req
 
     def words(self, req: Request) -> List[str]:
         """Detokenized summary, truncated at the first EOS (the metric

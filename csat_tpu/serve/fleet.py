@@ -1,0 +1,555 @@
+"""Replica fleet: N ServeEngines behind one health-aware front door (ISSUE 11).
+
+The fleet turns the single continuous-batching engine into a service: it
+owns N :class:`~csat_tpu.serve.engine.ServeEngine` replicas — each with
+its OWN KV page pool, program cache, request queue, fault budgets and
+``MetricsRegistry`` — and exposes the same submit / poll / tick / drain
+contract the engine does, so the CLI loop and the bench drive either
+interchangeably.  What the layer adds:
+
+* **Routing** — deterministic join-shortest-queue dispatch over HEALTHY
+  replicas (:class:`~csat_tpu.serve.router.Router`); request → replica is
+  a pure function of the submitted trace.
+* **Fault domains** — a replica whose rebuild cap exhausts, whose tick
+  watchdog times out, or whose reaped-slot count hits the
+  ``serve_fleet_reap_storm`` trip moves to ``SICK``: its engine is closed
+  (postmortems flushed once — ``close()`` is idempotent), its queued work
+  is resubmitted to healthy replicas (at-most-once per attempt: only
+  requests with ZERO delivered tokens are retried, bounded by
+  ``serve_max_retries``), and the fleet keeps serving at
+  ``(N-1)/N`` capacity.  Faults on replica k never touch the other
+  replicas' schedules or outputs — each engine's admission order depends
+  only on its own trace, so healthy replicas stay bit-identical to a
+  fault-free run.
+* **Fleet admission control** — a global queue bound across healthy
+  replicas (``serve_fleet_max_queue``, deriving from
+  ``serve_max_queue × healthy`` when unset) reusing the engine's
+  ``serve_queue_policy`` semantics: "reject" the new request, or
+  "shed_oldest" from the deepest healthy queue via the engine's public
+  :meth:`~csat_tpu.serve.engine.ServeEngine.shed_oldest`.
+* **Observability** — every replica's registry scrapes under a
+  ``replica="k"`` label (:meth:`prometheus`) or a ``replica<k>_`` key
+  prefix (:meth:`snapshot`, the ``MetricsFile`` JSONL surface);
+  per-replica postmortem dumps land in ``postmortem/replica<k>/``;
+  :meth:`summary` aggregates fleet throughput, capacity fraction and
+  MERGED latency quantiles (``obs.metrics.merge_histograms`` — never an
+  average of per-replica percentiles).
+
+The fleet composes engines strictly through their public API — the
+static boundary scan in ``tests/test_ops.py`` fails the build if this
+module (or the router) reaches into ``ServeEngine`` privates.
+
+Fleet ids are their own namespace: callers hold fleet ids; the fleet maps
+them to (replica, engine id) and rewrites the id on the returned Request,
+so a resubmission to a different replica is invisible to the caller.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import time
+from typing import Any, Callable, Dict, List, Optional, Sequence
+
+from csat_tpu.configs import Config
+from csat_tpu.obs import EventRecorder
+from csat_tpu.obs.metrics import MetricsRegistry, merge_histograms
+from csat_tpu.serve.engine import Request, RequestStatus, ServeEngine
+from csat_tpu.serve.router import DRAINING, HEALTHY, SICK, Router
+
+__all__ = ["Fleet", "Replica"]
+
+
+@dataclasses.dataclass
+class Replica:
+    """One engine plus its fleet-visible health record."""
+
+    index: int
+    engine: Optional[ServeEngine]
+    health: str = HEALTHY
+    sick_reason: Optional[str] = None
+    # stamped by the engine watchdog's on_timeout (monitor thread); the
+    # scheduler thread acts on it at the next fleet tick — retiring a
+    # replica from the monitor thread would race the tick loop
+    watchdog_tripped: bool = False
+    closed: bool = False
+
+
+@dataclasses.dataclass
+class _PendingSubmit:
+    """What the fleet retains to resubmit a queued request whose replica
+    retired: the original submit arguments (the engine releases its copy
+    of ``sample`` at any terminal transition, so the fleet keeps its own
+    reference until the request reaches a terminal state it will not
+    retry)."""
+
+    sample: Dict[str, Any]
+    max_new_tokens: int
+    deadline_t: Optional[float]  # absolute; remaining time recomputed at retry
+    attempts: int = 0
+
+
+class Fleet:
+    """N ``ServeEngine`` replicas behind one submit/poll/tick/drain door."""
+
+    def __init__(
+        self,
+        model: Any,
+        params: Any,
+        cfg: Config,
+        replicas: int = 0,
+        tgt_vocab: Any = None,
+        clock: Callable[[], float] = time.monotonic,
+        sample_seed: int = 0,
+        log: Callable[[str], None] = lambda m: None,
+    ):
+        n = replicas or cfg.serve_replicas
+        assert n >= 1, n
+        self.cfg = cfg
+        self.clock = clock
+        self.log = log
+        self.router = Router()
+        self.obs = EventRecorder(capacity=cfg.obs_events, component="fleet")
+        pm = cfg.obs_postmortem_dir
+        self._postmortem_dir = (
+            os.path.join(cfg.output_dir, "postmortem") if pm == "auto" else pm)
+        self.registry = MetricsRegistry()
+        self._m_submitted = self.registry.counter(
+            "fleet_requests_submitted_total", "requests accepted by the fleet")
+        self._m_rejected = self.registry.counter(
+            "fleet_requests_rejected_total",
+            "fleet-level rejections (no healthy replica / fleet queue full)")
+        self._m_shed = self.registry.counter(
+            "fleet_sheds_total", "fleet admission-control shed_oldest calls")
+        self._m_resubmitted = self.registry.counter(
+            "fleet_resubmissions_total",
+            "requests moved from a retired replica to a healthy one")
+        self._m_retired_replicas = self.registry.counter(
+            "fleet_replicas_retired_total", "replicas moved to SICK")
+        self._m_healthy = self.registry.gauge(
+            "fleet_healthy_replicas", "replicas currently in rotation")
+        self._m_capacity = self.registry.gauge(
+            "fleet_capacity_frac", "healthy decode slots / total decode slots")
+        self._m_queue = self.registry.gauge(
+            "fleet_queue_depth", "queued requests across live replicas")
+        self._m_occupancy = self.registry.gauge(
+            "fleet_slots_occupied", "busy decode slots across live replicas")
+        self.registry.gauge("fleet_replicas", "configured replica count").set(n)
+
+        self.replicas: List[Replica] = []
+        for k in range(n):
+            rep_cfg = cfg
+            if self._postmortem_dir:
+                rep_cfg = cfg.replace(obs_postmortem_dir=os.path.join(
+                    self._postmortem_dir, f"replica{k}"))
+            rep = Replica(index=k, engine=None)
+
+            def on_timeout(rep: Replica = rep) -> None:
+                # replaces the engine watchdog's default os._exit(76): in a
+                # fleet a wedged replica is a capacity event, not a process
+                # event — flag it and let the next tick retire the replica
+                rep.watchdog_tripped = True
+
+            rep.engine = ServeEngine(
+                model, params, rep_cfg, tgt_vocab=tgt_vocab, clock=clock,
+                sample_seed=sample_seed, watchdog_on_timeout=on_timeout,
+                log=(lambda m, k=k: log(f"[replica{k}] {m}")))
+            self.replicas.append(rep)
+
+        # fleet id → (replica index, engine-local id); the route is the
+        # single source of truth for where a request currently lives
+        self._routes: Dict[int, tuple] = {}
+        # fleet id → retained submit args while non-terminal (resubmission)
+        self._pending: Dict[int, _PendingSubmit] = {}
+        # fleet-synthesized terminal results (fleet-level rejections)
+        self._results: Dict[int, Request] = {}
+        self._next_id = 0
+        # fleet tick ordinal. Every replica engine is ticked exactly once
+        # per fleet tick from construction on (warm-up included), so this
+        # equals each live engine's next tick number — what fault drills
+        # use to aim `serve_decode_fail_ticks` at a specific replica
+        self.ticks = 0
+        self.resubmissions = 0
+        self.started_t = clock()
+        self._update_gauges()
+
+    # ---------------- public API (engine-shaped) ----------------
+
+    def submit(
+        self,
+        sample: Dict[str, Any],
+        max_new_tokens: int = 0,
+        deadline_s: Optional[float] = None,
+    ) -> int:
+        """Route one request to the least-loaded HEALTHY replica; returns a
+        fleet-scoped id — ALWAYS, matching the engine contract: fleet-level
+        refusals (no healthy replica, fleet queue bound under policy
+        "reject") resolve to a terminal REJECTED result immediately."""
+        fid = self._next_id
+        self._next_id += 1
+        now = self.clock()
+        self._m_submitted.inc()
+        healthy = [r for r in self.replicas if r.health == HEALTHY]
+        if not healthy:
+            self._reject(fid, now, "no healthy replicas")
+            return fid
+
+        # fleet-wide admission control over the healthy queues
+        bound = self.cfg.serve_fleet_max_queue or (
+            self.cfg.serve_max_queue * len(healthy))
+        if bound and sum(r.engine.queue_depth for r in healthy) >= bound:
+            if self.cfg.serve_queue_policy == "reject":
+                self._reject(fid, now, f"fleet queue full ({bound})")
+                return fid
+            target = self.router.shed_target(self.replicas)
+            if target is not None:
+                shed = target.engine.shed_oldest(
+                    f"shed by fleet admission control (queue {bound})")
+                if shed is not None:
+                    self._m_shed.inc()
+                    self.obs.emit("fleet.shed_oldest",
+                                  replica=target.index, engine_id=shed.id)
+
+        rep = self.router.pick(self.replicas)
+        eid = rep.engine.submit(
+            sample, max_new_tokens=max_new_tokens, deadline_s=deadline_s)
+        self._routes[fid] = (rep.index, eid)
+        self.obs.emit("fleet.route", id=fid, replica=rep.index, engine_id=eid)
+        if rep.engine.poll(eid) is None:
+            # non-terminal: retain the submit args so a replica retirement
+            # can move the request (terminal-at-submit outcomes stand)
+            ddl = (self.cfg.serve_deadline_s if deadline_s is None
+                   else deadline_s)
+            self._pending[fid] = _PendingSubmit(
+                sample=sample, max_new_tokens=max_new_tokens,
+                deadline_t=(now + ddl) if ddl and ddl > 0 else None)
+        self._update_gauges()
+        return fid
+
+    def poll(self, fid: int) -> Optional[Request]:
+        """The finished request under its FLEET id, or None in flight."""
+        req = self._results.get(fid)
+        if req is not None:
+            return req
+        route = self._routes.get(fid)
+        if route is None:
+            return None
+        ri, eid = route
+        req = self.replicas[ri].engine.poll(eid)
+        if req is not None:
+            req.id = fid  # callers hold fleet ids, not engine-local ids
+            self._pending.pop(fid, None)
+        return req
+
+    def pop_result(self, fid: int) -> Optional[Request]:
+        """Like :meth:`poll` but removes the result (bounded memory under
+        sustained traffic — same contract as the engine)."""
+        req = self._results.pop(fid, None)
+        if req is None:
+            route = self._routes.get(fid)
+            if route is None:
+                return None
+            ri, eid = route
+            req = self.replicas[ri].engine.pop_result(eid)
+            if req is None:
+                return None
+            req.id = fid
+        self._routes.pop(fid, None)
+        self._pending.pop(fid, None)
+        return req
+
+    def tick(self) -> int:
+        """One fleet round: tick every live replica, act on health trips
+        (retire SICK replicas and move their work), close emptied DRAINING
+        replicas; returns total slots still live."""
+        self.ticks += 1
+        live = 0
+        storm = self.cfg.serve_fleet_reap_storm
+        for rep in self.replicas:
+            if rep.closed or rep.health == SICK:
+                continue
+            if rep.watchdog_tripped:
+                self._retire_replica(rep, "watchdog timeout")
+                continue
+            try:
+                live += rep.engine.tick()
+            except Exception as e:  # noqa: BLE001 — engine-fatal: isolate it
+                # the engine's own self-healing is exhausted (rebuild cap)
+                # or its scheduler broke; in a fleet that retires ONE
+                # replica instead of killing the service
+                self._retire_replica(rep, str(e))
+                continue
+            if storm and rep.engine.stats.reaped >= storm:
+                self._retire_replica(
+                    rep, f"reap storm ({int(rep.engine.stats.reaped)} slots)")
+                continue
+            if (rep.health == DRAINING and not rep.engine.occupancy
+                    and not rep.engine.queue_depth):
+                rep.engine.close()
+                rep.closed = True
+        self._update_gauges()
+        return live
+
+    def drain(self, max_ticks: int = 0) -> Dict[int, Request]:
+        """Tick until every live replica is idle; returns {fleet id:
+        terminal Request} for every request the fleet still tracks."""
+        steps = self.cfg.max_tgt_len - 1
+        max_ticks = max_ticks or (
+            (self.queue_depth + self.num_slots + 1)
+            * (steps + self.cfg.serve_reap_margin + 2))
+        ticks = 0
+        while self._active():
+            self.tick()
+            ticks += 1
+            if ticks > max_ticks:
+                raise RuntimeError(
+                    f"fleet drain exceeded {max_ticks} ticks — "
+                    "a replica is not quiescing")
+        return self.results()
+
+    def generate(self, samples: Sequence[Dict[str, Any]],
+                 max_new_tokens: int = 0) -> List[Request]:
+        """Submit-all + drain convenience (warm-up, batch callers)."""
+        ids = [self.submit(s, max_new_tokens=max_new_tokens) for s in samples]
+        self.drain()
+        return [self.poll(i) for i in ids]
+
+    def shed_all(self, reason: str = "graceful drain deadline") -> int:
+        """Shed every queued and in-flight request on every live replica
+        (the graceful-shutdown escape hatch); returns the number shed."""
+        n = 0
+        for rep in self.replicas:
+            if rep.closed or rep.health == SICK:
+                continue
+            n += rep.engine.shed_all(reason)
+        # nothing survives to retry: the shed IS the terminal outcome
+        self._pending.clear()
+        self._update_gauges()
+        return n
+
+    def drain_replica(self, k: int) -> None:
+        """Operator-initiated retirement: replica ``k`` stops receiving
+        new work, finishes what it holds, then closes (next ticks)."""
+        rep = self.replicas[k]
+        if rep.health == HEALTHY:
+            rep.health = DRAINING
+            self.obs.emit("fleet.draining", replica=k)
+            self._update_gauges()
+
+    def close(self) -> None:
+        """Close every replica (idempotent — engine.close guards)."""
+        for rep in self.replicas:
+            rep.engine.close()
+            rep.closed = True
+
+    def words(self, req: Request) -> List[str]:
+        return self.replicas[0].engine.words(req)
+
+    # ---------------- state the router / callers read ----------------
+
+    @property
+    def num_slots(self) -> int:
+        return sum(r.engine.num_slots for r in self.replicas)
+
+    @property
+    def occupancy(self) -> int:
+        return sum(r.engine.occupancy for r in self.replicas if not r.closed)
+
+    @property
+    def queue_depth(self) -> int:
+        return sum(r.engine.queue_depth for r in self.replicas if not r.closed)
+
+    @property
+    def healthy_replicas(self) -> List[Replica]:
+        return [r for r in self.replicas if r.health == HEALTHY]
+
+    @property
+    def capacity_frac(self) -> float:
+        """Healthy decode slots as a fraction of configured slots — the
+        sick-replica drill's headline: one of N equal replicas down
+        reads (N-1)/N."""
+        total = sum(r.engine.num_slots for r in self.replicas)
+        healthy = sum(r.engine.num_slots for r in self.healthy_replicas)
+        return healthy / total if total else 0.0
+
+    @property
+    def routes(self) -> Dict[int, int]:
+        """fleet id → replica index (the router's decision record; the
+        determinism test replays a trace and asserts equality)."""
+        return {fid: ri for fid, (ri, _) in self._routes.items()}
+
+    def results(self) -> Dict[int, Request]:
+        """Every tracked request that has reached a terminal state, keyed
+        by fleet id (fleet-synthesized rejections included)."""
+        out: Dict[int, Request] = {}
+        for fid in list(self._routes):
+            req = self.poll(fid)
+            if req is not None:
+                out[fid] = req
+        out.update(self._results)
+        return out
+
+    # ---------------- observability ----------------
+
+    def prometheus(self) -> str:
+        """Fleet scrape surface: every replica's registry under a
+        ``replica="k"`` label, then the fleet-level series unlabeled."""
+        parts = [
+            rep.engine.stats.registry.prometheus(
+                labels={"replica": str(rep.index)})
+            for rep in self.replicas
+        ]
+        parts.append(self.registry.prometheus())
+        return "".join(parts)
+
+    def snapshot(self) -> Dict[str, float]:
+        """Flat JSONL snapshot (the ``MetricsFile`` surface): fleet-level
+        series plus every replica's registry under a ``replica<k>_`` key
+        prefix — ``tools/obs_report.py --fleet`` splits these back out."""
+        out = dict(self.registry.snapshot())
+        for rep in self.replicas:
+            out.update(rep.engine.stats.registry.snapshot(
+                prefix=f"replica{rep.index}_"))
+        return out
+
+    def summary(self, wall_s: Optional[float] = None,
+                n_chips: int = 1) -> Dict[str, Any]:
+        """ServeStats-shaped fleet aggregate: summed outcome counters,
+        merged-histogram latency quantiles (percentiles of the union
+        distribution, not averaged per-replica percentiles), capacity
+        fraction, and a per-replica breakdown."""
+        if wall_s is None:
+            wall_s = self.clock() - self.started_t
+        per = []
+        for rep in self.replicas:
+            s = rep.engine.stats.summary(wall_s=wall_s, n_chips=n_chips)
+            per.append({"replica": rep.index, "health": rep.health,
+                        "sick_reason": rep.sick_reason, **s})
+
+        def total(key: str) -> float:
+            return sum(p[key] for p in per)
+
+        lat = merge_histograms(
+            [rep.engine.stats.latency_hist for rep in self.replicas],
+            name="fleet_request_latency_seconds")
+        wait = merge_histograms(
+            [rep.engine.stats.wait_hist for rep in self.replicas],
+            name="fleet_request_wait_seconds")
+        tps = total("gen_tokens") / wall_s if wall_s and wall_s > 0 else 0.0
+        return {
+            "replicas": len(self.replicas),
+            "healthy_replicas": len(self.healthy_replicas),
+            "capacity_frac": round(self.capacity_frac, 4),
+            "num_slots": self.num_slots,
+            # fleet ids issued; per-replica `submitted` double-counts moved
+            # requests (each attempt is an engine submit), so the fleet
+            # total is the authoritative request count
+            "submitted": self._next_id,
+            "fleet_rejected": int(self._m_rejected.value),
+            "fleet_shed": int(self._m_shed.value),
+            "resubmissions": self.resubmissions,
+            "replicas_retired": int(self._m_retired_replicas.value),
+            "admitted": total("admitted"),
+            "retired": total("retired"),
+            "rejected": total("rejected") + int(self._m_rejected.value),
+            "shed": total("shed"),
+            "timeouts": total("timeouts"),
+            "failed": total("failed"),
+            "quarantined": total("quarantined"),
+            "reaped": total("reaped"),
+            "rebuilds": total("rebuilds"),
+            "decode_steps": total("decode_steps"),
+            "prefill_calls": total("prefill_calls"),
+            "compiles": total("compiles"),
+            "gen_tokens": total("gen_tokens"),
+            "wall_s": round(wall_s, 3),
+            "gen_tokens_per_sec": round(tps, 2),
+            "gen_tokens_per_sec_per_chip": round(tps / max(n_chips, 1), 2),
+            "latency_p50_s": round(lat.quantile(50), 4),
+            "latency_p95_s": round(lat.quantile(95), 4),
+            "wait_p50_s": round(wait.quantile(50), 4),
+            "wait_p95_s": round(wait.quantile(95), 4),
+            "per_replica": per,
+        }
+
+    # ---------------- internals ----------------
+
+    def _active(self) -> bool:
+        for rep in self.replicas:
+            if rep.closed or rep.health == SICK:
+                continue
+            if rep.watchdog_tripped:
+                return True  # next tick retires it
+            if rep.engine.occupancy or rep.engine.queue_depth:
+                return True
+        return False
+
+    def _reject(self, fid: int, now: float, why: str) -> None:
+        req = Request(id=fid, sample=None,
+                      limit=self.cfg.max_tgt_len - 1, submit_t=now)
+        req.status = RequestStatus.REJECTED
+        req.error = why
+        req.done_t = now
+        self._results[fid] = req
+        self._m_rejected.inc()
+        self.obs.emit("fleet.reject", id=fid, error=why)
+
+    def _retire_replica(self, rep: Replica, reason: str) -> None:
+        """SICK transition: shed the replica's work, close its engine
+        (one postmortem flush), then move zero-token sheds to healthy
+        replicas — at-most-once per attempt: a request that got ANY
+        tokens delivered keeps its terminal SHED outcome."""
+        rep.health = SICK
+        rep.sick_reason = reason
+        rep.watchdog_tripped = False
+        self._m_retired_replicas.inc()
+        self.obs.emit("fleet.retire", replica=rep.index, reason=reason)
+        self.log(f"# fleet: replica {rep.index} SICK ({reason}); "
+                 f"capacity {self.capacity_frac:.2f}")
+        eng = rep.engine
+        shed_reason = f"replica {rep.index} retired: {reason}"
+        eng.shed_all(shed_reason)
+        eng.close()
+        rep.closed = True
+        if self._postmortem_dir and self.obs.enabled:
+            self.obs.postmortem(self._postmortem_dir,
+                                f"retire_replica{rep.index}")
+
+        for fid, (ri, eid) in sorted(self._routes.items()):
+            if ri != rep.index:
+                continue
+            req = eng.poll(eid)
+            entry = self._pending.get(fid)
+            if (req is None or entry is None
+                    or req.status != RequestStatus.SHED
+                    or req.error != shed_reason or req.n_tokens):
+                continue  # terminal before retirement, or tokens delivered
+            entry.attempts += 1
+            if entry.attempts > self.cfg.serve_max_retries:
+                self._pending.pop(fid, None)
+                continue  # retry budget spent: the SHED stands
+            target = self.router.pick(self.replicas)
+            if target is None:
+                self._pending.pop(fid, None)
+                continue  # nowhere to go: the SHED stands
+            now = self.clock()
+            if entry.deadline_t is not None and entry.deadline_t <= now:
+                self._pending.pop(fid, None)
+                continue  # would expire on arrival
+            ddl = (entry.deadline_t - now
+                   if entry.deadline_t is not None else 0)
+            eid2 = target.engine.submit(
+                entry.sample, max_new_tokens=entry.max_new_tokens,
+                deadline_s=ddl)
+            self._routes[fid] = (target.index, eid2)
+            self.resubmissions += 1
+            self._m_resubmitted.inc()
+            self.obs.emit("fleet.resubmit", id=fid, replica=target.index,
+                          engine_id=eid2, from_replica=rep.index)
+        self._update_gauges()
+
+    def _update_gauges(self) -> None:
+        self._m_healthy.set(len(self.healthy_replicas))
+        self._m_capacity.set(round(self.capacity_frac, 4))
+        self._m_queue.set(self.queue_depth)
+        self._m_occupancy.set(self.occupancy)

@@ -1,0 +1,61 @@
+"""Health-aware replica routing for the serve fleet (ISSUE 11).
+
+The router is deliberately a pure function of public replica state — it
+reads ``engine.queue_depth`` and ``engine.occupancy`` (both properties on
+:class:`~csat_tpu.serve.engine.ServeEngine`) plus the fleet's per-replica
+health record, and never touches engine internals (the static boundary
+scan in ``tests/test_ops.py`` pins this).  Keeping it stateless makes the
+fleet's dispatch a deterministic function of the submitted trace: same
+trace, same request → replica assignment, every run.
+
+Health states form a one-way ladder per replica:
+
+* ``HEALTHY`` — in rotation: receives new work.
+* ``DRAINING`` — operator-initiated retirement: no new admissions, keeps
+  ticking until its queue and slots empty, then closes.
+* ``SICK`` — fault-tripped (rebuild cap exhausted, watchdog timeout, reap
+  storm): immediately retired and routed around; its queued work is
+  resubmitted to healthy replicas by the fleet.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+__all__ = ["HEALTHY", "SICK", "DRAINING", "Router"]
+
+HEALTHY = "HEALTHY"
+DRAINING = "DRAINING"
+SICK = "SICK"
+
+
+class Router:
+    """Deterministic join-shortest-queue dispatch over HEALTHY replicas.
+
+    Load is ``queue_depth + occupancy`` — the work a replica still owes,
+    which is what bounds a new request's wait (queue position) plus slot
+    contention.  Ties break on the LOWEST replica index, so dispatch is a
+    pure function of the trace (the fleet determinism test replays a
+    seeded trace and asserts identical routes)."""
+
+    @staticmethod
+    def load(replica) -> int:
+        return replica.engine.queue_depth + replica.engine.occupancy
+
+    def pick(self, replicas: Sequence) -> Optional[object]:
+        """The HEALTHY replica new work goes to; None when none remain."""
+        healthy = [r for r in replicas if r.health == HEALTHY]
+        if not healthy:
+            return None
+        return min(healthy, key=lambda r: (self.load(r), r.index))
+
+    def shed_target(self, replicas: Sequence) -> Optional[object]:
+        """Where fleet-level ``shed_oldest`` sheds from: the HEALTHY
+        replica with the deepest queue (ties on lowest index) — shedding
+        anywhere else would leave the worst backlog untouched."""
+        healthy = [r for r in replicas
+                   if r.health == HEALTHY and r.engine.queue_depth]
+        if not healthy:
+            return None
+        return min(healthy,
+                   key=lambda r: (-r.engine.queue_depth, r.index))

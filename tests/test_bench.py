@@ -67,12 +67,12 @@ def test_alive_tpu_best_variant_wins(bench, monkeypatch, capsys):
     monkeypatch.setattr(bench, "_run_child", fake_child)
     out = _run_main(bench, capsys)
     assert out["device"] == "tpu"
-    # the 4th variant wins: the 5th (bucketed, 104) is excluded from the
-    # headline pool — vs_baseline stays defined on the padded-credit
-    # fixed-shape protocol
+    # the 4th variant wins: the 5th-7th (bucketed 104, serve 105, fleet
+    # 106) are excluded from the headline pool — vs_baseline stays defined
+    # on the padded-credit fixed-shape protocol
     assert out["value"] == 103.0
     assert "degraded" not in out
-    assert len(out["all_variants"]) == 6
+    assert len(out["all_variants"]) == 7
     # one probe + ONE serve for the whole device group (single claim)
     assert [c[0] for c in calls] == ["--probe", "--serve"]
 
@@ -162,6 +162,41 @@ def test_serve_record_paging_fields_survive_embedding(bench, monkeypatch, capsys
             assert v[k] == want, (k, v)
 
 
+def test_fleet_record_fields_survive_embedding(bench, monkeypatch, capsys):
+    """A fleet-mode child record's sick-replica-drill fields (capacity
+    fraction, bit-identity verdict, per-replica breakdown, N=2-vs-solo
+    throughput) must survive into the final JSON's all_variants — they
+    carry the ISSUE 11 fleet-serving claim."""
+    fleet_fields = {"replicas": 2, "fleet_tps_per_chip": 400.0,
+                    "solo_tps_per_chip": 250.0, "vs_solo": 1.6,
+                    "capacity_frac": 0.5, "sick_replicas": [1],
+                    "nonterminal_after_drain": 0,
+                    "sick_replica_bit_identical": True, "resubmissions": 3,
+                    "per_replica": [{"replica": 0, "health": "HEALTHY"},
+                                    {"replica": 1, "health": "SICK"}]}
+
+    def fake_child(args, timeout_s, cpu_only=False):
+        if args[0] == "--probe":
+            return {"ok": True, "platform": "tpu", "n_devices": 1}, None
+        for spec in args[1].split(","):
+            _emit(bench, {"phase": "start", "spec": spec})
+            rec = _result(spec, 100.0)
+            if rec["mode"] == "fleet":
+                rec.update(fleet_fields, num_slots=8,
+                           gen_tokens_per_sec_per_chip=400.0)
+            _emit(bench, rec)
+        _emit(bench, {"phase": "done"})
+        return {"ok": True, "phase": "done"}, None
+
+    monkeypatch.setattr(bench, "_run_child", fake_child)
+    out = _run_main(bench, capsys)
+    fleet_recs = [v for v in out["all_variants"] if v["mode"] == "fleet"]
+    assert fleet_recs, "spec list must carry a fleet variant"
+    for v in fleet_recs:
+        for k, want in fleet_fields.items():
+            assert v[k] == want, (k, v)
+
+
 def test_killed_serve_retries_untried_first(bench, monkeypatch, capsys):
     """A serve child killed mid-variant: the retry round runs the missing
     specs with the killed one LAST, and the final JSON carries both the
@@ -190,7 +225,7 @@ def test_killed_serve_retries_untried_first(bench, monkeypatch, capsys):
     monkeypatch.setattr(bench, "_run_child", fake_child)
     out = _run_main(bench, capsys)
     assert state["round"] == 2
-    assert len(out["all_variants"]) == 6
+    assert len(out["all_variants"]) == 7
     assert out["value"] == 300.0
     assert "killed during" not in out.get("notes", "")  # retried successfully
 
@@ -216,7 +251,7 @@ def test_deterministic_error_not_retried(bench, monkeypatch, capsys):
     out = _run_main(bench, capsys)
     assert state["serves"] == 1  # error is final: no retry round
     assert "non-finite" in out["notes"]
-    assert len(out["all_variants"]) == 5
+    assert len(out["all_variants"]) == 6
 
 
 def test_malformed_bench_variants_flagged(bench, monkeypatch, capsys):
@@ -258,7 +293,7 @@ def test_done_record_authoritative_over_stdout_marker(bench, monkeypatch, capsys
     out = _run_main(bench, capsys)
     assert state["serves"] == 1  # done record suppressed the retry round
     assert "serve:" not in out.get("notes", "")
-    assert len(out["all_variants"]) == 6
+    assert len(out["all_variants"]) == 7
     assert "degraded" not in out
 
 

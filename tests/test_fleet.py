@@ -1,0 +1,378 @@
+"""Replica fleet (ISSUE 11 tentpole): router, fault domains, fleet serving.
+
+Pins the fleet's four contracts:
+
+* **fault isolation** — the sick-replica drill: a rebuild-cap trip on
+  replica k retires exactly that replica (capacity ``(N-1)/N``), its
+  queued work moves to healthy replicas (at-most-once: zero-token
+  attempts only), every request still reaches exactly one terminal
+  status, and the surviving replicas' OK outputs stay bit-identical to a
+  fault-free solo engine over the same trace;
+* **routing** — join-shortest-queue dispatch over HEALTHY replicas is a
+  pure function of the submitted trace (replaying a trace reproduces the
+  same fleet id → replica map), and SICK/DRAINING replicas receive no
+  new work;
+* **compile discipline** — steady state holds per replica: replaying a
+  warm trace adds zero compiles on any healthy replica;
+* **observability** — per-replica registries scrape under a
+  ``replica="k"`` label / ``replica<k>_`` snapshot prefix, and the fleet
+  summary aggregates outcome counters with MERGED latency histograms.
+"""
+
+import numpy as np
+import pytest
+
+from csat_tpu.data.toy import random_request_sample
+from csat_tpu.resilience import FaultInjector
+from csat_tpu.serve import (
+    DRAINING,
+    HEALTHY,
+    SICK,
+    Fleet,
+    RequestStatus,
+    Router,
+    ServeEngine,
+    collate_requests,
+)
+
+SRC_V, TGT_V, TRIP_V = 200, 300, 50
+
+
+@pytest.fixture(scope="module")
+def fleet_cfg(micro_config):
+    """Deterministic micro config on the bit-identity paths (full
+    attention, zero dropout, shape-invariant CSE empty rows) with 2 slots
+    per replica and a rebuild cap of zero, so one injected decode fault
+    retires a replica."""
+    return micro_config.replace(
+        full_att=True, dropout=0.0, attention_dropout=0.0,
+        cse_empty_rows="zero", serve_slots=2,
+        bucket_src_lens=(24, 48), serve_max_rebuilds=0,
+    )
+
+
+@pytest.fixture(scope="module")
+def stack(fleet_cfg):
+    """(cfg, model, params) shared by the module; fleets are per-test."""
+    from csat_tpu.train.state import create_train_state, default_optimizer, make_model
+
+    cfg = fleet_cfg
+    model = make_model(cfg, SRC_V, TGT_V, TRIP_V)
+    warm = collate_requests(
+        [random_request_sample(cfg, SRC_V, TRIP_V, 8, seed=0)],
+        cfg.max_src_len, 1, cfg, tgt_width=cfg.max_tgt_len - 1)
+    params = create_train_state(
+        model, default_optimizer(cfg), warm, seed=0).params
+    return cfg, model, params
+
+
+def _requests(cfg, n, seed=0, lo=5):
+    rng = np.random.default_rng(seed)
+    return [
+        random_request_sample(cfg, SRC_V, TRIP_V, int(ln), seed=1000 * seed + i)
+        for i, ln in enumerate(rng.integers(lo, cfg.max_src_len, n))
+    ]
+
+
+def _solo_reference(cfg, model, params, samples):
+    """Fault-free single-engine run of the same trace — the bit-identity
+    reference for every healthy-replica output."""
+    solo = ServeEngine(model, params, cfg, sample_seed=0)
+    reqs = solo.generate(samples)
+    solo.close()
+    return reqs
+
+
+# ---------------------------------------------------------------------------
+# fault isolation (the sick-replica drill)
+# ---------------------------------------------------------------------------
+
+
+def test_sick_replica_drill_isolated_and_bit_identical(stack):
+    """Mid-trace rebuild-cap trip on replica 1: the fleet keeps serving at
+    1/2 capacity, queued work moves to replica 0, drain leaves exactly one
+    terminal status per request, and every OK output equals the fault-free
+    solo run of the same sample."""
+    cfg, model, params = stack
+    samples = _requests(cfg, 12, seed=1)
+    solo_reqs = _solo_reference(cfg, model, params, samples)
+
+    fleet = Fleet(model, params, cfg, replicas=2, sample_seed=0)
+    ids = [fleet.submit(s) for s in samples]
+    fleet.tick()
+    fleet.tick()
+    # decode faults on replica 1 from its next tick on; rebuild cap 0 means
+    # the first one exhausts the engine's self-healing and the fleet
+    # retires the replica
+    fleet.replicas[1].engine.fault_injector = FaultInjector(
+        serve_decode_fail_ticks=frozenset(
+            range(fleet.ticks, fleet.ticks + 10_000)))
+    results = fleet.drain()
+
+    assert fleet.replicas[1].health == SICK
+    assert "rebuild" in fleet.replicas[1].sick_reason
+    assert fleet.replicas[0].health == HEALTHY
+    assert fleet.capacity_frac == 0.5
+    # exactly one terminal outcome per submitted request, nothing in flight
+    assert sorted(results) == sorted(ids)
+    for fid in ids:
+        req = results[fid]
+        assert req.status in RequestStatus.TERMINAL, (fid, req.status)
+        assert req.id == fid
+    # fault isolation: whatever finished OK (on replica 0 throughout, on
+    # replica 1 before the fault, or moved off replica 1 by resubmission)
+    # is bit-identical to the fault-free solo run
+    n_ok = 0
+    for fid, sample, ref in zip(ids, samples, solo_reqs):
+        req = results[fid]
+        if req.status == RequestStatus.OK:
+            n_ok += 1
+            assert req.n_tokens == ref.n_tokens
+            np.testing.assert_array_equal(
+                np.asarray(req.tokens), np.asarray(ref.tokens))
+    assert n_ok > 0, "drill must leave some requests served"
+    # only SHED zero-progress attempts were moved (at-most-once): any
+    # non-OK leftovers are replica-1 in-flight casualties, marked SHED
+    for fid in ids:
+        if results[fid].status != RequestStatus.OK:
+            assert results[fid].status == RequestStatus.SHED
+    fleet.close()
+
+
+def test_resubmission_moves_queued_work_to_healthy_replica(stack):
+    """A deep queue at retirement time: the zero-token queued requests are
+    resubmitted to the healthy replica and finish OK there."""
+    cfg, model, params = stack
+    samples = _requests(cfg, 10, seed=2)
+    fleet = Fleet(model, params, cfg, replicas=2, sample_seed=0)
+    ids = [fleet.submit(s) for s in samples]
+    before = dict(fleet.routes)
+    on_r1 = [fid for fid, ri in before.items() if ri == 1]
+    fleet.tick()
+    fleet.replicas[1].engine.fault_injector = FaultInjector(
+        serve_decode_fail_ticks=frozenset(
+            range(fleet.ticks, fleet.ticks + 10_000)))
+    results = fleet.drain()
+    assert fleet.resubmissions > 0
+    # moved requests now route to replica 0 and completed there
+    moved = [fid for fid in on_r1 if fleet.routes.get(fid) == 0]
+    assert len(moved) == fleet.resubmissions
+    for fid in moved:
+        assert results[fid].status == RequestStatus.OK
+    assert int(fleet.registry.snapshot()["fleet_resubmissions_total"]) == \
+        fleet.resubmissions
+    fleet.close()
+
+
+def test_watchdog_trip_retires_replica_not_process(stack):
+    """The fleet replaces the engine watchdog's process-kill default: a
+    tripped flag retires ONE replica at the next tick."""
+    cfg, model, params = stack
+    fleet = Fleet(model, params, cfg, replicas=2)
+    fleet.replicas[0].watchdog_tripped = True
+    fleet.tick()
+    assert fleet.replicas[0].health == SICK
+    assert fleet.replicas[0].sick_reason == "watchdog timeout"
+    assert fleet.replicas[1].health == HEALTHY
+    # the survivor still serves
+    reqs = fleet.generate(_requests(cfg, 2, seed=3))
+    assert all(r.status == RequestStatus.OK for r in reqs)
+    assert set(fleet.routes.values()) == {1}
+    fleet.close()
+
+
+# ---------------------------------------------------------------------------
+# routing
+# ---------------------------------------------------------------------------
+
+
+def test_router_is_deterministic_over_a_trace(stack):
+    """Replaying the identical submitted trace on a fresh fleet reproduces
+    the identical fleet id → replica map."""
+    cfg, model, params = stack
+    samples = _requests(cfg, 9, seed=4)
+
+    def routes_of():
+        fleet = Fleet(model, params, cfg, replicas=2, sample_seed=0)
+        for s in samples:
+            fleet.submit(s)
+            fleet.tick()
+        fleet.drain()
+        routes = dict(fleet.routes)
+        fleet.close()
+        return routes
+
+    first, second = routes_of(), routes_of()
+    assert first == second
+    assert set(first.values()) == {0, 1}, "JSQ must use both replicas"
+
+
+def test_router_skips_unhealthy_replicas():
+    """Router.pick never selects SICK or DRAINING replicas and breaks load
+    ties by replica index; shed_target picks the deepest healthy queue."""
+
+    class _Eng:
+        def __init__(self, queue, busy):
+            self.queue_depth, self.occupancy = queue, busy
+
+    class _Rep:
+        def __init__(self, index, health, queue=0, busy=0):
+            self.index, self.health = index, health
+            self.engine = _Eng(queue, busy)
+
+    router = Router()
+    reps = [_Rep(0, SICK, queue=0), _Rep(1, HEALTHY, queue=2),
+            _Rep(2, HEALTHY, queue=1, busy=1), _Rep(3, DRAINING)]
+    assert router.pick(reps).index == 1  # load 2 vs 2 → lowest index wins
+    assert router.shed_target(reps).index == 1  # deepest healthy queue
+    assert router.pick([reps[0], reps[3]]) is None
+    assert router.shed_target([_Rep(1, HEALTHY)]) is None  # nothing queued
+
+
+def test_draining_replica_finishes_then_closes(stack):
+    """drain_replica: no new work routes to a DRAINING replica; it finishes
+    what it holds and closes, and the fleet id → result path survives."""
+    cfg, model, params = stack
+    fleet = Fleet(model, params, cfg, replicas=2, sample_seed=0)
+    samples = _requests(cfg, 6, seed=5)
+    ids = [fleet.submit(s) for s in samples[:4]]
+    fleet.tick()
+    fleet.drain_replica(1)
+    assert fleet.replicas[1].health == DRAINING
+    late = [fleet.submit(s) for s in samples[4:]]
+    assert all(fleet.routes[fid] == 0 for fid in late)
+    results = fleet.drain()
+    assert fleet.replicas[1].closed
+    for fid in ids + late:
+        assert results[fid].status == RequestStatus.OK
+    assert fleet.capacity_frac == 0.5
+    fleet.close()
+
+
+# ---------------------------------------------------------------------------
+# admission control
+# ---------------------------------------------------------------------------
+
+
+def test_fleet_queue_bound_reject(stack):
+    """Policy "reject": past the fleet-wide bound submits resolve to an
+    immediate terminal REJECTED result under the fleet id."""
+    cfg, model, params = stack
+    tight = cfg.replace(serve_fleet_max_queue=2, serve_queue_policy="reject")
+    fleet = Fleet(model, params, tight, replicas=2, sample_seed=0)
+    samples = _requests(cfg, 10, seed=6)
+    ids = [fleet.submit(s) for s in samples]  # no ticks: queues only fill
+    rejected = [fid for fid in ids
+                if (r := fleet.poll(fid)) is not None
+                and r.status == RequestStatus.REJECTED]
+    assert rejected, "the bound must trip"
+    assert all("fleet queue full" in fleet.poll(fid).error for fid in rejected)
+    results = fleet.drain()
+    assert sorted(results) == sorted(ids)
+    assert int(fleet.registry.snapshot()["fleet_requests_rejected_total"]) \
+        == len(rejected)
+    fleet.close()
+
+
+def test_fleet_queue_bound_shed_oldest(stack):
+    """Policy "shed_oldest": past the bound the deepest healthy queue sheds
+    its head (a terminal SHED), and the new request is admitted."""
+    cfg, model, params = stack
+    tight = cfg.replace(serve_fleet_max_queue=2,
+                        serve_queue_policy="shed_oldest")
+    fleet = Fleet(model, params, tight, replicas=2, sample_seed=0)
+    ids = [fleet.submit(s) for s in _requests(cfg, 10, seed=7)]
+    shed = [fid for fid in ids
+            if (r := fleet.poll(fid)) is not None
+            and r.status == RequestStatus.SHED]
+    assert shed, "shed_oldest must have fired"
+    assert int(fleet.registry.snapshot()["fleet_sheds_total"]) == len(shed)
+    results = fleet.drain()
+    assert sorted(results) == sorted(ids)
+    for fid in ids:
+        assert results[fid].status in (RequestStatus.OK, RequestStatus.SHED)
+    fleet.close()
+
+
+def test_no_healthy_replicas_rejects(stack):
+    """With every replica out of rotation, submits still return a fleet id
+    whose result is terminal REJECTED — never an exception."""
+    cfg, model, params = stack
+    fleet = Fleet(model, params, cfg, replicas=2)
+    for rep in fleet.replicas:
+        rep.watchdog_tripped = True
+    fleet.tick()
+    assert fleet.healthy_replicas == []
+    fid = fleet.submit(_requests(cfg, 1, seed=8)[0])
+    req = fleet.poll(fid)
+    assert req.status == RequestStatus.REJECTED
+    assert "no healthy replicas" in req.error
+    assert fleet.drain()[fid] is req
+    fleet.close()
+
+
+# ---------------------------------------------------------------------------
+# compile discipline
+# ---------------------------------------------------------------------------
+
+
+def test_zero_steady_state_compiles_per_replica(stack):
+    """Replaying a warm trace adds zero compiles on every replica — the
+    per-replica program caches are independent and both warm."""
+    cfg, model, params = stack
+    fleet = Fleet(model, params, cfg, replicas=2, sample_seed=0)
+    samples = _requests(cfg, 8, seed=9)
+    fleet.generate(samples)
+    warm = [rep.engine.stats.compiles for rep in fleet.replicas]
+    assert all(c > 0 for c in warm)
+    fleet.generate(samples)
+    assert [rep.engine.stats.compiles for rep in fleet.replicas] == warm
+    fleet.close()
+
+
+# ---------------------------------------------------------------------------
+# observability + lifecycle
+# ---------------------------------------------------------------------------
+
+
+def test_summary_snapshot_and_prometheus_are_replica_scoped(stack):
+    """summary() aggregates outcome counters with merged-histogram latency
+    quantiles; snapshot()/prometheus() expose per-replica series under the
+    replica<k>_ prefix / replica="k" label."""
+    cfg, model, params = stack
+    fleet = Fleet(model, params, cfg, replicas=2, sample_seed=0)
+    reqs = fleet.generate(_requests(cfg, 6, seed=10))
+    assert all(r.status == RequestStatus.OK for r in reqs)
+
+    summ = fleet.summary(n_chips=1)
+    assert summ["replicas"] == 2
+    assert summ["healthy_replicas"] == 2
+    assert summ["capacity_frac"] == 1.0
+    assert summ["submitted"] == 6
+    assert summ["retired"] == 6
+    assert summ["gen_tokens"] == sum(r.n_tokens for r in reqs)
+    assert len(summ["per_replica"]) == 2
+    assert sum(p["retired"] for p in summ["per_replica"]) == 6
+    assert 0.0 <= summ["latency_p50_s"] <= summ["latency_p95_s"]
+
+    snap = fleet.snapshot()
+    for k in range(2):
+        assert snap[f"replica{k}_serve_requests_submitted_total"] >= 1
+    assert snap["fleet_requests_submitted_total"] == 6
+    text = fleet.prometheus()
+    assert 'replica="0"' in text and 'replica="1"' in text
+    assert "fleet_healthy_replicas 2" in text
+    fleet.close()
+
+
+def test_engine_close_is_idempotent(stack):
+    """Satellite 1: close() closes once, reports repeats, and the fleet's
+    own close() survives double invocation."""
+    cfg, model, params = stack
+    engine = ServeEngine(model, params, cfg)
+    assert engine.close() is True
+    assert engine.close() is False
+    fleet = Fleet(model, params, cfg, replicas=2)
+    fleet.close()
+    fleet.close()
+    assert all(rep.closed for rep in fleet.replicas)

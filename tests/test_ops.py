@@ -414,6 +414,33 @@ class TestStaticOneKernelModel:
         assert not offenders, offenders
 
 
+class TestStaticFleetBoundary:
+    """Fleet-layer boundary (ISSUE 11 satellite): the fleet and the router
+    compose ``ServeEngine`` strictly through its public API.  Any
+    ``obj._name`` attribute access on a non-``self`` object in
+    ``serve/fleet.py`` or ``serve/router.py`` — ``engine._queue``,
+    ``engine._rebuild_and_resubmit``, … — is a violation: resilience
+    semantics must stay inside the engine, and the fleet must survive
+    engine-internal refactors."""
+
+    ROOT = pathlib.Path(__file__).resolve().parent.parent
+    FILES = ("csat_tpu/serve/fleet.py", "csat_tpu/serve/router.py")
+
+    def test_no_private_attribute_reach_through(self):
+        offenders = []
+        for rel in self.FILES:
+            path = self.ROOT / rel
+            tree = ast.parse(path.read_text(), filename=str(path))
+            for node in ast.walk(tree):
+                if (isinstance(node, ast.Attribute)
+                        and node.attr.startswith("_")
+                        and not node.attr.startswith("__")
+                        and not (isinstance(node.value, ast.Name)
+                                 and node.value.id == "self")):
+                    offenders.append(f"{rel}:{node.lineno} .{node.attr}")
+        assert not offenders, offenders
+
+
 @pytest.mark.slow
 def test_model_backend_pallas_matches_xla_forward():
     """Full CSATrans forward with backend=pallas == backend=xla (same rngs)."""

@@ -33,6 +33,7 @@ from __future__ import annotations
 import argparse
 import json
 import os
+import re
 import sys
 from typing import Dict, Iterable, List, Optional, Tuple
 
@@ -123,6 +124,53 @@ def _fmt_table(rows: List[Tuple], headers: Tuple) -> str:
     return "\n".join([line(headers), sep] + [line(r) for r in rows])
 
 
+_REPLICA_KEY_RE = re.compile(r"^replica(\d+)_(.+)$")
+
+# (column header, per-replica metric key) for the fleet table; counters
+# sum into the fleet totals row, the latency histogram renders as mean ms
+_FLEET_COLS = (
+    ("submitted", "serve_requests_submitted_total"),
+    ("ok", "serve_requests_ok_total"),
+    ("failed", "serve_requests_failed_total"),
+    ("timeout", "serve_requests_timeout_total"),
+    ("shed", "serve_requests_shed_total"),
+    ("queue", "serve_queue_depth"),
+    ("busy", "serve_slots_occupied"),
+    ("gen_tokens", "serve_gen_tokens_total"),
+)
+
+
+def split_fleet_snapshot(snap: dict) -> List[dict]:
+    """One fleet snapshot (``Fleet.snapshot`` — per-replica series under a
+    ``replica<k>_`` key prefix) → per-replica plain dicts, index order."""
+    per: Dict[int, dict] = {}
+    for key, v in snap.items():
+        m = _REPLICA_KEY_RE.match(key)
+        if m:
+            per.setdefault(int(m.group(1)), {})[m.group(2)] = v
+    return [per[k] for k in sorted(per)]
+
+
+def fleet_table(snaps: List[dict]) -> str:
+    """Per-replica counter table plus a summed fleet totals row, from the
+    replicas' last metrics snapshots."""
+    rows: List[Tuple] = []
+    totals = {col: 0 for col, _ in _FLEET_COLS}
+    for k, snap in enumerate(snaps):
+        row: List = [f"replica{k}"]
+        for col, key in _FLEET_COLS:
+            v = snap.get(key, 0) or 0
+            row.append(v)
+            totals[col] += v
+        lat_n = snap.get("serve_request_latency_seconds_count") or 0
+        lat_s = snap.get("serve_request_latency_seconds_sum") or 0.0
+        row.append(round(lat_s / lat_n * 1e3, 1) if lat_n else "-")
+        rows.append(tuple(row))
+    rows.append(("fleet", *(totals[c] for c, _ in _FLEET_COLS), "-"))
+    return _fmt_table(
+        rows, ("replica", *(c for c, _ in _FLEET_COLS), "lat_mean_ms"))
+
+
 def history_table(history: List[dict]) -> str:
     """The bench trajectory as a table: one row per ledger entry, raw and
     calibration-normalized headline side by side."""
@@ -152,9 +200,22 @@ def history_table(history: List[dict]) -> str:
 
 def report(metrics_path: Optional[str] = None,
            events_path: Optional[str] = None,
-           history_path: Optional[str] = None) -> str:
+           history_path: Optional[str] = None,
+           fleet_paths: Optional[List[str]] = None) -> str:
     """The one-screen report as a string (main() prints it)."""
     sections: List[str] = []
+    if fleet_paths:
+        # either one fleet metrics file (replica<k>_-prefixed keys, the
+        # serve CLI's --replicas N --metrics_file output) or N per-replica
+        # metrics files, comma-separated
+        snaps: List[dict] = []
+        for path in fleet_paths:
+            all_snaps = load_metrics(path)
+            last = all_snaps[-1] if all_snaps else {}
+            split = split_fleet_snapshot(last)
+            snaps.extend(split if split else [last])
+        sections.append(
+            f"== fleet ({len(snaps)} replica(s)) ==\n" + fleet_table(snaps))
     if metrics_path:
         snaps = load_metrics(metrics_path)
         if snaps:
@@ -200,7 +261,8 @@ def report(metrics_path: Optional[str] = None,
             sections.append(f"(no ledger entries in {history_path})")
     if not sections:
         sections.append(
-            "nothing to report: pass --metrics, --events and/or --history")
+            "nothing to report: pass --metrics, --events, --history "
+            "and/or --fleet")
     return "\n\n".join(sections)
 
 
@@ -212,9 +274,15 @@ def main(argv: Optional[List[str]] = None) -> None:
                    help="flight-recorder dump (JSONL) or Chrome trace JSON")
     p.add_argument("--history", default="",
                    help="perf ledger JSONL (results/perf/history.jsonl)")
+    p.add_argument("--fleet", default="",
+                   help="fleet metrics: ONE fleet snapshot file "
+                        "(replica<k>_-prefixed keys, `csat_tpu serve "
+                        "--replicas N --metrics_file ...`) or comma-"
+                        "separated per-replica metrics JSONL files")
     args = p.parse_args(argv)
+    fleet = [s for s in args.fleet.split(",") if s] if args.fleet else None
     print(report(args.metrics or None, args.events or None,
-                 args.history or None))
+                 args.history or None, fleet))
 
 
 if __name__ == "__main__":
