@@ -314,6 +314,9 @@ def _measure_one(spec: str, heartbeat=None) -> dict:
     if mode == "fleet":
         # batch field = slots PER REPLICA, steps field = request count
         return _measure_fleet(backend, dtype, batch_size, n_steps, heartbeat)
+    if mode == "chaos":
+        # batch field = slots per replica, steps field = per-phase requests
+        return _measure_chaos(backend, dtype, batch_size, n_steps, heartbeat)
     import jax
     import numpy as np
 
@@ -891,7 +894,7 @@ def _measure_fleet(backend: str, dtype: str, num_slots: int,
 
     from csat_tpu.configs import get_config
     from csat_tpu.data.toy import random_request_sample
-    from csat_tpu.resilience.faults import FaultInjector
+    from csat_tpu.resilience.chaos import FaultEvent, FaultPlan
     from csat_tpu.serve.engine import RequestStatus, ServeEngine
     from csat_tpu.serve.fleet import Fleet
     from csat_tpu.serve.prefill import collate_requests
@@ -987,13 +990,12 @@ def _measure_fleet(backend: str, dtype: str, num_slots: int,
                    "programs": int(sum(compiles_warm)) + solo_compiles})
 
     def drill() -> None:
-        # decode faults on replica 1 from its next tick on; with
+        # sick-replica drill via the declarative FaultPlan path (ISSUE 12):
+        # permanent decode faults on replica 1 from its next tick on; with
         # serve_max_rebuilds=0 the first one exhausts the rebuild cap and
-        # the fleet retires the replica. fleet.ticks == each live
-        # engine's next tick ordinal (engines tick in lockstep)
-        fleet.replicas[1].engine.fault_injector = FaultInjector(
-            serve_decode_fail_ticks=frozenset(
-                range(fleet.ticks, fleet.ticks + 10_000)))
+        # the fleet retires the replica
+        FaultPlan((FaultEvent("retire_replica", at=0, replica=1),),
+                  name="sick_replica").apply(fleet)
 
     rng2 = np.random.default_rng(4)
     fleet_wall, fleet_reqs = run_trace(fleet, replicas, drill=drill)
@@ -1071,6 +1073,197 @@ def _measure_fleet(backend: str, dtype: str, num_slots: int,
         "nodes_per_sec_per_chip": 0.0,
         "real_nodes_per_sec_per_chip": 0.0,
     }
+    _record_variant_metrics(rec, t_compile)
+    return rec
+
+
+def _measure_chaos(backend: str, dtype: str, num_slots: int,
+                   n_requests: int, heartbeat=None) -> dict:
+    """Chaos proving ground (ISSUE 12): a full FaultPlan under an
+    adversarial multi-tenant trace, with the live invariant monitor
+    attached — the bench-level record of the degradation acceptance drill.
+
+    Three phases over a 2-replica fleet at identical geometry:
+
+    * **uncontended** — the multi-tenant trace at ~1/3 capacity, fault
+      free: the per-class latency yardstick (gold-tier p95 baseline);
+    * **overload** — the same trace shape offered at 2x capacity, still
+      fault free: the graceful-degradation drill.  Recorded claims:
+      gold-tier p95 within 1.5x its uncontended baseline while the batch
+      tier is brownout-capped and then shed first
+      (``serve_priority_classes=3`` + ``serve_brownout_max_new_tokens``
+      + priority-aware ``shed_oldest``);
+    * **chaos** — the ``adversarial`` zoo trace (bursty arrivals, poison
+      flood through ingest, duplicate storm on the prefix cache, bimodal
+      length skew) while a FaultPlan fires NaN logits + a wedged slot on
+      replica 0 and retires replica 1 mid-trace.  Recorded claims: ZERO
+      invariant violations, drain leaves zero non-terminal requests, and
+      the fleet keeps serving at ``capacity_frac == 1/2``.
+
+    Any invariant violation in any phase marks the whole bench artifact
+    ``degraded`` (never silently published).
+    """
+    import jax
+    import numpy as np
+
+    from csat_tpu.configs import get_config
+    from csat_tpu.data.toy import random_request_sample
+    from csat_tpu.resilience.chaos import FaultEvent, FaultPlan, run_chaos
+    from csat_tpu.resilience.invariants import InvariantMonitor
+    from csat_tpu.serve.fleet import Fleet
+    from csat_tpu.serve.prefill import collate_requests
+    from csat_tpu.serve.traffic import zoo_spec, make_trace
+
+    replicas = 2
+    overrides = dict(backend=backend, compute_dtype=dtype, prefetch=0,
+                     serve_slots=num_slots,
+                     # deterministic decode paths (serve exactness recipe)
+                     full_att=True, dropout=0.0, attention_dropout=0.0,
+                     cse_empty_rows="zero", serve_max_rebuilds=0,
+                     # the degradation ladder under test: 3 tiers, bounded
+                     # queues, brownout before shedding, priority-aware shed
+                     serve_priority_classes=3,
+                     serve_max_queue=max(2 * num_slots, 4),
+                     serve_queue_policy="shed_oldest",
+                     serve_brownout_queue_frac=0.5,
+                     serve_brownout_max_new_tokens=2,
+                     serve_retry_after_s=0.25,
+                     serve_resubmit_backoff_s=0.02)
+    if backend == "pallas":
+        overrides["noise_mode"] = "counter"
+    probe = get_config("python", **overrides)
+    overrides["bucket_src_lens"] = (probe.max_src_len,)
+    cfg = get_config("python", **overrides)
+    src_v, tgt_v, trip_v = 10_000, 20_000, 1246
+
+    from csat_tpu.train.state import create_train_state, default_optimizer, make_model
+
+    model = make_model(cfg, src_v, tgt_v, trip_v)
+    warm = collate_requests(
+        [random_request_sample(cfg, src_v, trip_v, 8, seed=0)],
+        cfg.max_src_len, num_slots, cfg, tgt_width=cfg.max_tgt_len - 1)
+    params = create_train_state(
+        model, default_optimizer(cfg), warm, seed=cfg.seed).params
+
+    t_compile = time.perf_counter()
+    fleet = Fleet(model, params, cfg, replicas=replicas, sample_seed=1)
+    fleet.generate(
+        [random_request_sample(cfg, src_v, trip_v, spec.n, seed=30 + i)
+         for i, spec in enumerate(fleet.replicas[0].engine.specs)
+         for _ in range(replicas)],
+        max_new_tokens=2)
+    programs = int(sum(r.engine.stats.compiles for r in fleet.replicas))
+    t_compile = time.perf_counter() - t_compile
+    if heartbeat is not None:
+        heartbeat({"phase": "compiled", "compile_s": round(t_compile, 1),
+                   "programs": programs})
+
+    # offered-load calibration: at full occupancy the fleet completes one
+    # request per (budget / total slots) ticks
+    svc = max(8.0 / max(num_slots * replicas, 1), 0.5)
+
+    # ---- phase A: uncontended multi-tenant baseline ----------------------
+    spec_a = zoo_spec("bursty_multitenant", n_requests=n_requests, seed=11,
+                      arrival="poisson", mean_interarrival=3.0 * svc)
+    mon_a = InvariantMonitor(cfg)
+    t0 = time.perf_counter()
+    rep_a = run_chaos(fleet, make_trace(spec_a, cfg, src_v, trip_v),
+                      plan=None, monitor=mon_a, strict=False)
+    wall_a = time.perf_counter() - t0
+    gold_a = rep_a.per_class.get("gold", {}).get("latency_p95_s", 0.0)
+    if heartbeat is not None:
+        heartbeat({"phase": "uncontended", "gold_p95_s": gold_a,
+                   "violations": len(rep_a.violations)})
+
+    # ---- phase B: 2x offered load, fault free (degradation drill) --------
+    # steady 2x (poisson) isolates the overload response — priority
+    # admission + brownout — from burst dynamics, which phase C owns
+    spec_b = zoo_spec("bursty_multitenant", n_requests=3 * n_requests,
+                      seed=12, arrival="poisson",
+                      mean_interarrival=0.5 * svc)
+    mon_b = InvariantMonitor(cfg)
+    t0 = time.perf_counter()
+    rep_b = run_chaos(fleet, make_trace(spec_b, cfg, src_v, trip_v),
+                      plan=None, monitor=mon_b, strict=False)
+    wall_b = time.perf_counter() - t0
+    gold_b = rep_b.per_class.get("gold", {}).get("latency_p95_s", 0.0)
+    batch_b = rep_b.per_class.get("batch", {})
+    if heartbeat is not None:
+        heartbeat({"phase": "overload", "gold_p95_s": gold_b,
+                   "browned": rep_b.browned,
+                   "violations": len(rep_b.violations)})
+
+    # ---- phase C: adversarial trace + the full fault schedule ------------
+    spec_c = zoo_spec("adversarial", n_requests=2 * n_requests, seed=13,
+                      mean_interarrival=0.75 * svc)
+    plan = FaultPlan((
+        FaultEvent("nan_logits", at=2, slot=0, replica=0),
+        FaultEvent("wedge_slot", at=5, slot=1 % num_slots, replica=0),
+        FaultEvent("retire_replica", at=2 * num_slots, replica=1),
+    ), name="bench_chaos")
+    mon_c = InvariantMonitor(cfg)
+    t0 = time.perf_counter()
+    rep_c = run_chaos(fleet, make_trace(spec_c, cfg, src_v, trip_v),
+                      plan=plan, monitor=mon_c, strict=False)
+    wall_c = time.perf_counter() - t0
+    batch_c = rep_c.per_class.get("batch", {})
+    summ = fleet.summary(wall_s=wall_a + wall_b + wall_c, n_chips=1)
+    fleet.close()
+
+    violations = rep_a.violations + rep_b.violations + rep_c.violations
+    n_chips = jax.device_count()
+    gen = int(summ["gen_tokens"])
+    wall = wall_a + wall_b + wall_c
+    rec = {
+        "ok": True,
+        "backend": backend,
+        "dtype": dtype,
+        "mode": "chaos",
+        "noise_mode": cfg.noise_mode,
+        "device": jax.devices()[0].platform,
+        "n_chips": n_chips,
+        "loss": 0.0,
+        "compile_s": round(t_compile, 1),
+        "steps": int(summ["decode_steps"]),
+        "step_ms": round(wall / max(summ["decode_steps"], 1) * 1e3, 2),
+        "num_slots": num_slots,
+        "engine_slots": num_slots * replicas,
+        "replicas": replicas,
+        "requests": rep_a.submitted + rep_b.submitted + rep_c.submitted,
+        "programs": programs,
+        "gen_tokens": gen,
+        "gen_tokens_per_sec_per_chip": round(gen / wall / n_chips, 2),
+        # ---- chaos acceptance evidence (ISSUE 12) ----
+        "trace": spec_c.name,
+        "fault_plan": [e.kind for e in plan.events],
+        "chaos_violations": len(violations),
+        "invariant_checks": rep_a.checks + rep_b.checks + rep_c.checks,
+        "capacity_frac": rep_c.capacity_frac,
+        "per_class_p95": {c: pc.get("latency_p95_s", 0.0)
+                          for c, pc in rep_b.per_class.items()},
+        "high_p95_uncontended_s": gold_a,
+        "high_p95_overload_s": gold_b,
+        "high_p95_ratio": round(gold_b / gold_a, 3) if gold_a > 0 else 0.0,
+        "brownout_capped": rep_b.browned + rep_c.browned,
+        "low_priority_shed": int(batch_b.get("shed", 0)
+                                 + batch_b.get("rejected", 0)
+                                 + batch_c.get("shed", 0)
+                                 + batch_c.get("rejected", 0)),
+        "resubmissions": rep_c.resubmissions,
+        "poison_budget_hits": rep_c.poison_budget_hits,
+        "outcomes": rep_c.outcomes,
+        "nonterminal_after_drain": sum(
+            pc.get("unresolved", 0) for pc in rep_c.per_class.values()),
+        "req_failed": summ["failed"],
+        "req_timeouts": summ["timeouts"],
+        "req_rejected": summ["rejected"] + summ["shed"],
+        # keep the shared-record contract so the variant table renders
+        "nodes_per_sec_per_chip": 0.0,
+        "real_nodes_per_sec_per_chip": 0.0,
+    }
+    if violations:
+        rec["violation_invariants"] = sorted(
+            {v["invariant"] for v in violations})
     _record_variant_metrics(rec, t_compile)
     return rec
 
@@ -1390,11 +1583,15 @@ def main() -> None:
             "pallas:bfloat16:default:64:20",
             "xla:float32:default:64:20:bucketed",
             "xla:float32:default:16:64:serve",
-            # replica fleet LAST: 3 engines' compiles make it the most
-            # expensive variant, so soft-budget exhaustion skips it
+            # replica fleet near-last: 3 engines' compiles make it the
+            # most expensive variant, so soft-budget exhaustion skips it
             # without starving the proven specs (batch field = slots per
             # replica, steps field = request count)
             "xla:float32:default:8:32:fleet",
+            # chaos proving ground rides the same warm compile cache as
+            # the fleet variant (identical geometry): FaultPlan + invariant
+            # monitor + overload/brownout drill — see _measure_chaos
+            "xla:float32:default:8:24:chaos",
         ]
     else:
         # honest CPU comparison: f32 at batch 6 — both frameworks' measured
@@ -1411,9 +1608,13 @@ def main() -> None:
             "pallas:float32:cpu:4:5",
             "xla:float32:cpu:6:4:bucketed",
             "xla:float32:cpu:4:10:serve",
-            # replica-fleet mode last (2 slots per replica, 8-request trace
+            # replica-fleet mode (2 slots per replica, 8-request trace
             # with the mid-trace sick-replica drill) — see _measure_fleet
             "xla:float32:cpu:2:8:fleet",
+            # chaos proving ground last (2 slots per replica, 6 requests
+            # per phase): adversarial trace + FaultPlan + invariant
+            # monitor, warm from the fleet variant's compile cache
+            "xla:float32:cpu:2:6:chaos",
         ]
 
     # -- phase 2: one serve child per platform group (one chip claim for all
@@ -1506,6 +1707,15 @@ def main() -> None:
         for err in r.get("probe_errors", ()):
             notes.append(f"{r['backend']}:{r['dtype']} {err}")
 
+    # chaos invariant violations (ISSUE 12): a dirty chaos run is NEVER
+    # silently published — the whole artifact is marked degraded with the
+    # violated invariants named
+    bad_chaos = [r for r in results if r.get("chaos_violations", 0) > 0]
+    for r in bad_chaos:
+        notes.append(
+            f"chaos: {r['chaos_violations']} invariant violation(s) "
+            f"({', '.join(r.get('violation_invariants', ())) or 'unknown'})")
+
     # When THIS run cannot produce a device number but an earlier session in
     # the same working tree archived on-chip results (tools/tpu_recovery.sh
     # copies the serve JSONL to results/perf/bench_results_tpu_*.jsonl), embed
@@ -1577,7 +1787,7 @@ def main() -> None:
         real = [r for r in results
                 if not (r["device"] == "cpu" and r["backend"] == "pallas")
                 and r.get("mode", "fixed") not in ("bucketed", "serve",
-                                                   "fleet")]
+                                                   "fleet", "chaos")]
         pool = real or results
         best = max(pool, key=lambda r: r["nodes_per_sec_per_chip"])
         value = best["nodes_per_sec_per_chip"]
@@ -1612,7 +1822,7 @@ def main() -> None:
                 "alive" if tpu_alive else (probe_err or "cpu-only platform")
             ),
         }
-        if degraded or bad_parity:
+        if degraded or bad_parity or bad_chaos:
             out["degraded"] = True
         if tpu_session:
             out["tpu_session"] = tpu_session
@@ -1645,7 +1855,15 @@ def main() -> None:
                                      "nonterminal_after_drain",
                                      "sick_replica_bit_identical",
                                      "bit_identical_requests",
-                                     "resubmissions")
+                                     "resubmissions",
+                                     # chaos proving ground (ISSUE 12)
+                                     "trace", "fault_plan",
+                                     "chaos_violations", "invariant_checks",
+                                     "violation_invariants", "per_class_p95",
+                                     "high_p95_uncontended_s",
+                                     "high_p95_overload_s", "high_p95_ratio",
+                                     "brownout_capped", "low_priority_shed",
+                                     "poison_budget_hits", "outcomes")
                    if k in r}
             # self-describing artifact (r4 verdict weak #6): pallas on CPU is
             # pl.pallas_call(interpret=True) — a correctness canary, not a
@@ -1662,7 +1880,8 @@ def main() -> None:
 
         out["all_variants"] = [_variant_rec(r) for r in results]
         reasons = ((["no_device"] if degraded else [])
-                   + (["parity"] if bad_parity else []))
+                   + (["parity"] if bad_parity else [])
+                   + (["chaos"] if bad_chaos else []))
         for r in results:
             print(f"# {r['backend']}:{r['dtype']} on {r['device']}: "
                   f"{r['nodes_per_sec_per_chip']:.0f} nodes/s/chip "

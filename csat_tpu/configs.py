@@ -255,6 +255,24 @@ class Config:
     # replicas) — stuck slots at this rate mean the replica, not the
     # requests. 0 = off (rebuild-cap and watchdog trips still retire)
     serve_fleet_reap_storm: int = 0
+    # --- SLO-aware degradation (ISSUE 12: traffic zoo + brownout) ---
+    # tenant priority tiers (0 = most important). 1 = single-class FIFO
+    # (priority arguments are clamped to 0 and every knob below is inert)
+    serve_priority_classes: int = 1
+    # brownout engages when the queue crosses this fraction of
+    # serve_max_queue: tiers > 0 get their decode budget capped at
+    # serve_brownout_max_new_tokens BEFORE anyone is rejected/shed.
+    # Requires a bounded queue (serve_max_queue > 0) to engage
+    serve_brownout_queue_frac: float = 0.75
+    serve_brownout_max_new_tokens: int = 8
+    # structured backpressure hint stamped on REJECTED/SHED outcomes,
+    # scaled by queue depth (engine._retry_hint). 0 = no hint
+    serve_retry_after_s: float = 0.5
+    # fleet resubmission backoff: base * 2^(attempt-1), capped at max,
+    # with deterministic seeded jitter in [0.5x, 1.0x). 0 = immediate
+    # resubmission (the PR 11 behavior)
+    serve_resubmit_backoff_s: float = 0.05
+    serve_resubmit_backoff_max_s: float = 2.0
     # --- training resilience follow-ups (ROADMAP) ---
     # device-side liveness probe on the step watchdog: a tiny chained
     # collective heartbeat runs on its own thread; if the device stops
@@ -469,6 +487,16 @@ class Config:
         assert self.serve_replicas >= 1, self.serve_replicas
         assert self.serve_fleet_max_queue >= 0, self.serve_fleet_max_queue
         assert self.serve_fleet_reap_storm >= 0, self.serve_fleet_reap_storm
+        assert self.serve_priority_classes >= 1, self.serve_priority_classes
+        assert 0 < self.serve_brownout_queue_frac <= 1, (
+            self.serve_brownout_queue_frac)
+        assert self.serve_brownout_max_new_tokens >= 0, (
+            self.serve_brownout_max_new_tokens)
+        assert self.serve_retry_after_s >= 0, self.serve_retry_after_s
+        assert self.serve_resubmit_backoff_s >= 0, self.serve_resubmit_backoff_s
+        assert (self.serve_resubmit_backoff_max_s
+                >= self.serve_resubmit_backoff_s), (
+            self.serve_resubmit_backoff_max_s)
         assert self.snapshot_every_steps >= 0, self.snapshot_every_steps
         assert self.obs_events >= 0, self.obs_events
         assert self.obs_metrics_every_s > 0, self.obs_metrics_every_s

@@ -29,13 +29,19 @@ the injector's serve-side faults (NaN logits, prefill failure, tick
 hang, wedged slot, decode fault) all come from here.
 """
 
+from csat_tpu.resilience.chaos import (  # noqa: F401
+    ChaosReport, FaultEvent, FaultPlan, run_chaos,
+)
 from csat_tpu.resilience.faults import CorruptBatchError, FaultInjector  # noqa: F401
+from csat_tpu.resilience.invariants import (  # noqa: F401
+    InvariantMonitor, InvariantViolationError, Violation,
+)
 from csat_tpu.resilience.guards import (  # noqa: F401
     TrainingDivergedError, guarded_apply, host_snapshot, restore_snapshot,
 )
 from csat_tpu.resilience.preemption import (  # noqa: F401
-    EXIT_PREEMPTED, Preempted, PreemptionHandler, read_resume_marker,
-    write_resume_marker,
+    EXIT_PREEMPTED, Preempted, PreemptionHandler, abort_barrier,
+    coordinated_trigger, read_resume_marker, write_resume_marker,
 )
 from csat_tpu.resilience.retry import DataErrorBudgetExceeded, ErrorBudget, retry  # noqa: F401
 from csat_tpu.resilience.watchdog import (  # noqa: F401

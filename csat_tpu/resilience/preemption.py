@@ -13,6 +13,19 @@ the in-flight step is lost, never a ``save_interval`` window.
 The preemption snapshot lives in its own ``preempt/`` subdirectory (its
 step key is the *in-progress* epoch, which would collide with the
 boundary checkpoints' completed-epoch keys in one orbax manager).
+
+Multi-host coordinated abort (ISSUE 12, closing the PR 1/4 carryover):
+the snapshot is an orbax COLLECTIVE save, so on a multi-process topology
+a SIGTERM delivered to only SOME hosts must not let them start saving
+while the others keep training — a torn collective wedges every host.
+:func:`coordinated_trigger` turns the per-host flag into a global OR
+(``multihost_utils.process_allgather``): every host observes "somebody
+was signalled" at the same step boundary and they enter the save
+together.  :func:`abort_barrier` is the second gate, synced immediately
+before the collective save begins (``sync_global_devices``) — by the
+time any host touches orbax, all hosts are provably inside the abort
+path.  Both degrade to local no-ops on a single process, which is what
+keeps the single-host tests and semantics unchanged.
 """
 
 from __future__ import annotations
@@ -25,9 +38,9 @@ import threading
 from typing import Iterator, Optional, Tuple
 
 __all__ = [
-    "EXIT_PREEMPTED", "Preempted", "PreemptionHandler",
-    "preempt_dir", "read_resume_marker", "snapshot_step",
-    "write_resume_marker",
+    "EXIT_PREEMPTED", "Preempted", "PreemptionHandler", "abort_barrier",
+    "coordinated_trigger", "preempt_dir", "read_resume_marker",
+    "snapshot_step", "write_resume_marker",
 ]
 
 # sysexits EX_TEMPFAIL: "try again later" — schedulers treat it as resumable
@@ -90,6 +103,73 @@ class PreemptionHandler:
         finally:
             for s, old in previous.items():
                 signal.signal(s, old)
+
+
+def coordinated_trigger(handler: PreemptionHandler,
+                        allgather=None,
+                        step_id: Optional[int] = None) -> bool:
+    """Whether ANY host has been asked to stop — the multi-host form of
+    ``handler.triggered``.
+
+    On a single process this IS ``handler.triggered`` (no collective, no
+    behavior change).  On a multi-process topology the local flag is
+    all-gathered and OR-reduced, so a SIGTERM delivered to a subset of
+    hosts stops every host at the same step boundary; when orbax's
+    preemption-sync machinery is available and ``step_id`` is given, its
+    ``reached_preemption_sync_point`` vote is OR'd in too (the managed
+    Cloud-TPU eviction signal arrives through that path, not SIGTERM).
+
+    ``allgather`` is injectable for tests: a callable mapping a local
+    ``np.int32`` array to the stacked per-process arrays (defaults to
+    ``jax.experimental.multihost_utils.process_allgather``)."""
+    import jax
+
+    if jax.process_count() <= 1 and allgather is None:
+        return handler.triggered
+    local = handler.triggered
+    if not local and step_id is not None:
+        try:  # orbax preemption_sync_manager route (managed evictions)
+            from jax.experimental import multihost_utils
+
+            local = bool(
+                multihost_utils.reached_preemption_sync_point(int(step_id)))
+        except (ImportError, AttributeError, RuntimeError):
+            pass  # no sync manager registered on this runtime: SIGTERM only
+    if allgather is None:
+        from jax.experimental import multihost_utils
+
+        allgather = multihost_utils.process_allgather
+    import numpy as np
+
+    flags = np.asarray(
+        allgather(np.asarray([1 if local else 0], np.int32)))
+    any_triggered = bool(flags.any())
+    if any_triggered and not handler.triggered:
+        # latch the consensus locally: later local checks (and the save
+        # path's own gate) see the same answer without another collective
+        handler.trigger()
+    return any_triggered
+
+
+def abort_barrier(tag: str = "preempt_save") -> str:
+    """Cross-host sync point entered immediately before the collective
+    preemption save; returns how it synced: ``"single"`` (one process —
+    nothing to sync), ``"barrier"`` (all hosts rendezvoused), or
+    ``"unavailable"`` (no multihost runtime — degrade to the PR-1
+    uncoordinated behavior rather than deadlock a single host).  Runtime
+    errors from a REAL barrier propagate: a failed rendezvous means some
+    host is not entering the save, and starting a torn orbax collective
+    is the exact failure this gate exists to prevent."""
+    import jax
+
+    if jax.process_count() <= 1:
+        return "single"
+    try:
+        from jax.experimental import multihost_utils
+    except ImportError:
+        return "unavailable"
+    multihost_utils.sync_global_devices(f"csat_tpu.abort.{tag}")
+    return "barrier"
 
 
 def preempt_dir(checkpoint_dir: str) -> str:

@@ -51,3 +51,14 @@ from csat_tpu.serve.prefix import PrefixCache, sample_hash  # noqa: F401
 from csat_tpu.serve.router import DRAINING, HEALTHY, SICK, Router  # noqa: F401
 from csat_tpu.serve.slots import SlotPool, build_decode_step, init_pool  # noqa: F401
 from csat_tpu.serve.stats import ServeStats, percentile  # noqa: F401
+from csat_tpu.serve.traffic import (  # noqa: F401
+    DEFAULT_CLASSES,
+    TRACE_ZOO,
+    PriorityClass,
+    Trace,
+    TraceItem,
+    TraceSpec,
+    make_trace,
+    replay,
+    zoo_spec,
+)

@@ -216,11 +216,12 @@ def _telemetry(engine, cfg, args):
 
 
 def _ingest(engine, cfg, src_vocab, trip_vocab, code: str,
-            max_new_tokens: int) -> Optional[int]:
+            max_new_tokens: int, priority: int = 0) -> Optional[int]:
     from csat_tpu.serve.ingest import sample_from_source
 
     sample = sample_from_source(code, cfg, src_vocab, trip_vocab)
-    return engine.submit(sample, max_new_tokens=max_new_tokens)
+    return engine.submit(sample, max_new_tokens=max_new_tokens,
+                         priority=priority)
 
 
 def _summarize(args) -> None:
@@ -274,12 +275,13 @@ def _summarize(args) -> None:
 
 
 def _parse_request(line: str, n_anon: int):
-    """One stdin line → ``(ext_id, code, max_new_tokens_override, n_anon,
-    error)``.  Never raises: a malformed line (bad JSON handled by the
-    bare-string fallback; a non-object JSON value; a missing/non-string
+    """One stdin line → ``(ext_id, code, max_new_tokens_override, priority,
+    n_anon, error)``.  Never raises: a malformed line (bad JSON handled by
+    the bare-string fallback; a non-object JSON value; a missing/non-string
     ``code`` field) comes back as ``error`` so the serve loop emits one
     error record and keeps going — one bad client must not take down the
-    stream."""
+    stream.  ``priority`` is optional (default 0 = highest tier); old
+    clients that never send it are unaffected."""
     try:
         rec = json.loads(line)
     except json.JSONDecodeError:
@@ -287,7 +289,7 @@ def _parse_request(line: str, n_anon: int):
     if isinstance(rec, str):
         rec = {"code": rec}
     if not isinstance(rec, dict):
-        return n_anon, None, None, n_anon + 1, (
+        return n_anon, None, None, 0, n_anon + 1, (
             f"request line must be a JSON object or a bare string, "
             f"got {type(rec).__name__}")
     ext_id = rec.get("id")
@@ -296,7 +298,7 @@ def _parse_request(line: str, n_anon: int):
         n_anon += 1
     code = rec.get("code")
     if not isinstance(code, str):
-        return ext_id, None, None, n_anon, (
+        return ext_id, None, None, 0, n_anon, (
             "missing or non-string 'code' field")
     # None = field absent (server default applies); an EXPLICIT 0 means
     # "full decode budget" (engine.submit semantics) and must survive
@@ -305,8 +307,16 @@ def _parse_request(line: str, n_anon: int):
         try:
             max_new = int(max_new)
         except (TypeError, ValueError):
-            return ext_id, None, None, n_anon, "non-integer 'max_new_tokens'"
-    return ext_id, code, max_new, n_anon, None
+            return (ext_id, None, None, 0, n_anon,
+                    "non-integer 'max_new_tokens'")
+    priority = rec.get("priority", 0)
+    try:
+        priority = int(priority)
+    except (TypeError, ValueError):
+        return ext_id, None, None, 0, n_anon, "non-integer 'priority'"
+    if priority < 0:
+        return ext_id, None, None, 0, n_anon, "negative 'priority'"
+    return ext_id, code, max_new, priority, n_anon, None
 
 
 class _StdinLines:
@@ -381,6 +391,12 @@ def _serve(args) -> None:
                 rec["latency_s"] = round(req.done_t - req.submit_t, 4)
             else:
                 rec["error"] = req.error or req.status
+            if req.status in ("REJECTED", "SHED"):
+                # structured load-shedding response: which tier was refused
+                # and when the client should come back (brownout-aware hint)
+                rec["priority"] = req.priority
+                if req.retry_after_s is not None:
+                    rec["retry_after_s"] = req.retry_after_s
             print(json.dumps(rec), flush=True)
 
     pending: dict = {}
@@ -411,7 +427,7 @@ def _serve(args) -> None:
                 for line in stdin.read_lines(0.0 if busy else 0.2):
                     if not line.strip():
                         continue
-                    ext_id, code, max_new, n_anon, err = _parse_request(
+                    ext_id, code, max_new, pr, n_anon, err = _parse_request(
                         line, n_anon)
                     if err is not None:
                         print(json.dumps({"id": ext_id, "status": "FAILED",
@@ -421,7 +437,7 @@ def _serve(args) -> None:
                         rid = _ingest(
                             engine, cfg, src_vocab, trip_vocab, code,
                             max_new if max_new is not None
-                            else args.max_new_tokens)
+                            else args.max_new_tokens, priority=pr)
                         pending[rid] = ext_id
                     except DataErrorBudgetExceeded:
                         raise  # poison budget spent — fail loud

@@ -54,6 +54,7 @@ small ``(S, 3)`` status fetch plus the queue bookkeeping.
 from __future__ import annotations
 
 import dataclasses
+import math
 import os
 import time
 from collections import defaultdict, deque
@@ -131,6 +132,12 @@ class Request:
     status: str = RequestStatus.PENDING
     error: Optional[str] = None     # human-readable cause for non-OK outcomes
     attempts: int = 0               # resubmissions consumed by pool rebuilds
+    priority: int = 0               # tenant tier (0 = most important; higher
+    #                                 tiers brownout and shed first)
+    retry_after_s: Optional[float] = None  # backpressure hint stamped on
+    #                                 REJECTED/SHED (None = no hint configured)
+    browned: bool = False           # decode budget was brownout-capped
+    backoff_s: float = 0.0          # total fleet resubmission backoff served
     admit_tick: Optional[int] = None  # engine tick at admission (reaper clock)
     phash: Optional[bytes] = None   # content hash (prefix cache on): computed
     #                                 ONCE at submit — admission may re-plan a
@@ -394,6 +401,7 @@ class ServeEngine:
         sample: Dict[str, np.ndarray],
         max_new_tokens: int = 0,
         deadline_s: Optional[float] = None,
+        priority: int = 0,
     ) -> int:
         """Queue one request; returns its id — ALWAYS, even when the
         request is refused: admission control and the poison quarantine
@@ -403,20 +411,24 @@ class ServeEngine:
         full ``max_tgt_len - 1`` steps; generation stops earlier at the
         first EOS either way).  ``deadline_s`` (seconds from now; None =
         ``cfg.serve_deadline_s``, 0 = none) bounds the request's total
-        latency.
+        latency.  ``priority`` is the tenant tier (0 = most important,
+        clamped to ``cfg.serve_priority_classes``): under pressure the
+        highest-numbered tier is brownout-capped first and shed first.
 
         The only exception path is budget exhaustion: a stream whose
         poison count exceeds ``cfg.serve_poison_budget`` raises
         :class:`~csat_tpu.resilience.retry.DataErrorBudgetExceeded`."""
         now = self.clock()
         limit = self.steps if max_new_tokens <= 0 else min(max_new_tokens, self.steps)
+        pr = max(0, min(int(priority), self.cfg.serve_priority_classes - 1))
         ddl = self.cfg.serve_deadline_s if deadline_s is None else deadline_s
         req = Request(
             id=self._next_id, sample=sample, limit=limit, submit_t=now,
+            priority=pr,
             deadline_t=(now + ddl) if ddl and ddl > 0 else None)
         self._next_id += 1
         self.stats.submitted += 1
-        self.obs.emit("req.submit", id=req.id, limit=limit)
+        self.obs.emit("req.submit", id=req.id, limit=limit, priority=pr)
         if req.deadline_t is not None:
             self._has_deadlines = True
 
@@ -435,21 +447,56 @@ class ServeEngine:
         if self._prefix is not None:
             req.phash = sample_hash(sample)
 
-        # admission control: bounded queue with a structured outcome
+        # brownout: before anyone is refused, low tiers lose decode budget.
+        # Engages when the queue crosses serve_brownout_queue_frac of the
+        # bound — gold (priority 0) keeps its full budget throughout
         max_q = self.cfg.serve_max_queue
+        if (req.priority > 0 and max_q
+                and self.cfg.serve_brownout_max_new_tokens > 0
+                and len(self._queue) >= max(
+                    1, int(math.ceil(max_q * self.cfg.serve_brownout_queue_frac)))):
+            cap = min(self.cfg.serve_brownout_max_new_tokens, req.limit)
+            if cap < req.limit:
+                req.limit = cap
+                req.browned = True
+                self.stats.browned += 1
+                self.obs.emit("req.brownout", id=req.id, limit=cap,
+                              priority=req.priority)
+
+        # admission control: bounded queue with a structured outcome
         if max_q and len(self._queue) >= max_q:
             if self.cfg.serve_queue_policy == "reject":
                 self._finish(req, RequestStatus.REJECTED,
                              error=f"queue full ({max_q})", now=now)
                 self._flush_postmortems()
                 return req.id
-            shed = self._queue.popleft()  # shed_oldest: freshest work wins
+            # shed the least important queued work first (lowest tier =
+            # highest priority number; FIFO-oldest within the tier — with a
+            # single class this is exactly the legacy shed-oldest).  When
+            # everything queued outranks the newcomer, the newcomer itself
+            # is shed: load never evicts more important work
+            shed = self._shed_victim(req)
             self._finish(shed, RequestStatus.SHED,
                          error=f"shed by admission control (queue {max_q})",
                          now=now)
             self._flush_postmortems()
+            if shed is req:
+                return req.id
         self._queue.append(req)
         return req.id
+
+    def _shed_victim(self, incoming: Request) -> Request:
+        """The queued request to shed to admit ``incoming`` — or
+        ``incoming`` itself when nothing queued is expendable."""
+        worst: Optional[Request] = None
+        worst_j = -1
+        for j, r in enumerate(self._queue):
+            if worst is None or r.priority > worst.priority:
+                worst, worst_j = r, j
+        if worst is not None and worst.priority >= incoming.priority:
+            del self._queue[worst_j]
+            return worst
+        return incoming
 
     def poll(self, req_id: int) -> Optional[Request]:
         """The finished request, or None while queued/in flight."""
@@ -615,6 +662,41 @@ class ServeEngine:
     def queue_depth(self) -> int:
         return len(self._queue)
 
+    @property
+    def ticks(self) -> int:
+        """Next tick ordinal — the time base FaultPlan events aim at
+        (``resilience/chaos.py`` compiles relative offsets against this)."""
+        return self._tick_no
+
+    @property
+    def prefills(self) -> int:
+        """Next prefill-call ordinal (the ``prefill_fail`` FaultPlan
+        events' time base)."""
+        return self._n_prefills
+
+    def page_leaks(self) -> int:
+        """KV pages still allocated beyond what the prefix cache
+        legitimately pins — meaningful at quiescence (no live slots),
+        where any positive value is a leaked chain.  Rectangle layout has
+        no allocator, so it can't leak: always 0."""
+        if not self.paged:
+            return 0
+        pinned = self._prefix.pinned_pages if self._prefix is not None else 0
+        held = sum(
+            len(plan.self_chain) + (0 if plan.shared else len(plan.cross_chain))
+            for plan in self._slot_meta if plan is not None)
+        return self._allocator.used_pages - pinned - held
+
+    def _retry_hint(self) -> Optional[float]:
+        """Structured backpressure hint for REJECTED/SHED outcomes: the
+        configured base scaled by how deep the queue is relative to the
+        slot pool, so a flooded engine tells clients to back off harder.
+        None when the hint is disabled (``serve_retry_after_s == 0``)."""
+        base = self.cfg.serve_retry_after_s
+        if base <= 0:
+            return None
+        return round(base * (1.0 + len(self._queue) / max(self.num_slots, 1)), 3)
+
     def reset_stats(self) -> "ServeStats":
         """Fresh counters (compile history carried over) — callers warm the
         programs first, then measure a clean window."""
@@ -649,11 +731,14 @@ class ServeEngine:
             self.stats.record_request(req.submit_t, req.admit_t, now, req.n_tokens)
             self.obs.emit("req.ok", id=req.id, n_tokens=req.n_tokens)
         else:
+            if status in (RequestStatus.REJECTED, RequestStatus.SHED):
+                req.retry_after_s = self._retry_hint()
             self.stats.record_outcome(status)
             # terminal lifecycle event FIRST, then the post-mortem note —
             # the dump that follows includes this transition in its timeline
             self.obs.emit("req." + status.lower(), id=req.id,
-                          n_tokens=req.n_tokens, error=error)
+                          n_tokens=req.n_tokens, error=error,
+                          retry_after_s=req.retry_after_s)
             self._note_fault(status)
             if error:
                 self.log(f"# serve: request {req.id} {status}: {error}")
@@ -914,7 +999,21 @@ class ServeEngine:
         if not free or not self._queue:
             return
         take = min(len(free), len(self._queue))
-        window = [self._queue.popleft() for _ in range(take)]
+        if (self.cfg.serve_priority_classes > 1
+                and any(r.priority for r in self._queue)):
+            # SLO-aware admission: the window is the `take` most important
+            # queued requests by (tier, FIFO index) — with a single class
+            # (or all-gold traffic) this reduces to the legacy popleft.
+            # The skipped lower-tier requests keep their FIFO positions
+            qlist = list(self._queue)
+            picked = sorted(
+                range(len(qlist)), key=lambda j: (qlist[j].priority, j))[:take]
+            picked_set = set(picked)
+            window = [qlist[j] for j in picked]
+            self._queue = deque(
+                r for j, r in enumerate(qlist) if j not in picked_set)
+        else:
+            window = [self._queue.popleft() for _ in range(take)]
         groups: Dict[int, List[Request]] = defaultdict(list)
         for req in window:
             k = assign_prefill_bucket(self.specs, int(req.sample["num_node"]))

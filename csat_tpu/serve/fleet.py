@@ -87,6 +87,19 @@ class _PendingSubmit:
     max_new_tokens: int
     deadline_t: Optional[float]  # absolute; remaining time recomputed at retry
     attempts: int = 0
+    priority: int = 0            # tenant tier, re-submitted verbatim
+    backoff_s: float = 0.0       # total backoff this request has served
+
+
+@dataclasses.dataclass
+class _ScheduledRetry:
+    """A resubmission waiting out its backoff: the fleet holds the request
+    off the routing table until ``due_t`` (capped exponential backoff with
+    deterministic jitter), then re-routes it to a healthy replica."""
+
+    fid: int
+    due_t: float
+    from_replica: int
 
 
 class Fleet:
@@ -161,6 +174,10 @@ class Fleet:
         self._routes: Dict[int, tuple] = {}
         # fleet id → retained submit args while non-terminal (resubmission)
         self._pending: Dict[int, _PendingSubmit] = {}
+        # fleet id → scheduled resubmission serving its backoff; while an
+        # entry is here, poll() reports the request in flight (the retired
+        # replica's SHED is not the outcome unless the retry falls through)
+        self._retrying: Dict[int, _ScheduledRetry] = {}
         # fleet-synthesized terminal results (fleet-level rejections)
         self._results: Dict[int, Request] = {}
         self._next_id = 0
@@ -180,6 +197,7 @@ class Fleet:
         sample: Dict[str, Any],
         max_new_tokens: int = 0,
         deadline_s: Optional[float] = None,
+        priority: int = 0,
     ) -> int:
         """Route one request to the least-loaded HEALTHY replica; returns a
         fleet-scoped id — ALWAYS, matching the engine contract: fleet-level
@@ -212,7 +230,8 @@ class Fleet:
 
         rep = self.router.pick(self.replicas)
         eid = rep.engine.submit(
-            sample, max_new_tokens=max_new_tokens, deadline_s=deadline_s)
+            sample, max_new_tokens=max_new_tokens, deadline_s=deadline_s,
+            priority=priority)
         self._routes[fid] = (rep.index, eid)
         self.obs.emit("fleet.route", id=fid, replica=rep.index, engine_id=eid)
         if rep.engine.poll(eid) is None:
@@ -222,7 +241,8 @@ class Fleet:
                    else deadline_s)
             self._pending[fid] = _PendingSubmit(
                 sample=sample, max_new_tokens=max_new_tokens,
-                deadline_t=(now + ddl) if ddl and ddl > 0 else None)
+                deadline_t=(now + ddl) if ddl and ddl > 0 else None,
+                priority=priority)
         self._update_gauges()
         return fid
 
@@ -231,6 +251,10 @@ class Fleet:
         req = self._results.get(fid)
         if req is not None:
             return req
+        if fid in self._retrying:
+            # a resubmission is serving its backoff: the retired replica's
+            # SHED is not this request's outcome — it is still in flight
+            return None
         route = self._routes.get(fid)
         if route is None:
             return None
@@ -238,7 +262,7 @@ class Fleet:
         req = self.replicas[ri].engine.poll(eid)
         if req is not None:
             req.id = fid  # callers hold fleet ids, not engine-local ids
-            self._pending.pop(fid, None)
+            self._stamp_retry_record(req, self._pending.pop(fid, None))
         return req
 
     def pop_result(self, fid: int) -> Optional[Request]:
@@ -246,6 +270,8 @@ class Fleet:
         sustained traffic — same contract as the engine)."""
         req = self._results.pop(fid, None)
         if req is None:
+            if fid in self._retrying:
+                return None
             route = self._routes.get(fid)
             if route is None:
                 return None
@@ -255,14 +281,25 @@ class Fleet:
                 return None
             req.id = fid
         self._routes.pop(fid, None)
-        self._pending.pop(fid, None)
+        self._stamp_retry_record(req, self._pending.pop(fid, None))
         return req
+
+    @staticmethod
+    def _stamp_retry_record(req: Request,
+                            entry: Optional[_PendingSubmit]) -> None:
+        """Surface the fleet's resubmission history on the terminal record
+        (`attempts` / `backoff_s`) — postmortems and the CLI JSONL carry
+        the same numbers the invariant monitors check."""
+        if entry is not None and entry.attempts:
+            req.attempts = max(req.attempts, entry.attempts)
+            req.backoff_s = round(entry.backoff_s, 4)
 
     def tick(self) -> int:
         """One fleet round: tick every live replica, act on health trips
         (retire SICK replicas and move their work), close emptied DRAINING
         replicas; returns total slots still live."""
         self.ticks += 1
+        self._flush_retries()
         live = 0
         storm = self.cfg.serve_fleet_reap_storm
         for rep in self.replicas:
@@ -322,8 +359,10 @@ class Fleet:
             if rep.closed or rep.health == SICK:
                 continue
             n += rep.engine.shed_all(reason)
-        # nothing survives to retry: the shed IS the terminal outcome
+        # nothing survives to retry: the shed IS the terminal outcome —
+        # scheduled resubmissions fall back to their replicas' SHED records
         self._pending.clear()
+        self._retrying.clear()
         self._update_gauges()
         return n
 
@@ -357,7 +396,11 @@ class Fleet:
 
     @property
     def queue_depth(self) -> int:
-        return sum(r.engine.queue_depth for r in self.replicas if not r.closed)
+        # scheduled resubmissions count as queued: they are accepted work
+        # that has not reached a slot yet (drive loops must keep ticking)
+        return (sum(r.engine.queue_depth
+                    for r in self.replicas if not r.closed)
+                + len(self._retrying))
 
     @property
     def healthy_replicas(self) -> List[Replica]:
@@ -456,6 +499,7 @@ class Fleet:
             "timeouts": total("timeouts"),
             "failed": total("failed"),
             "quarantined": total("quarantined"),
+            "browned": total("browned"),
             "reaped": total("reaped"),
             "rebuilds": total("rebuilds"),
             "decode_steps": total("decode_steps"),
@@ -475,6 +519,8 @@ class Fleet:
     # ---------------- internals ----------------
 
     def _active(self) -> bool:
+        if self._retrying:
+            return True  # resubmissions still serving their backoff
         for rep in self.replicas:
             if rep.closed or rep.health == SICK:
                 continue
@@ -515,6 +561,7 @@ class Fleet:
             self.obs.postmortem(self._postmortem_dir,
                                 f"retire_replica{rep.index}")
 
+        now = self.clock()
         for fid, (ri, eid) in sorted(self._routes.items()):
             if ri != rep.index:
                 continue
@@ -528,25 +575,70 @@ class Fleet:
             if entry.attempts > self.cfg.serve_max_retries:
                 self._pending.pop(fid, None)
                 continue  # retry budget spent: the SHED stands
+            # schedule the resubmission behind capped exponential backoff
+            # with deterministic jitter — a retirement under load must not
+            # slam its whole queue onto the survivors in one tick
+            backoff = self._backoff_s(fid, entry.attempts)
+            entry.backoff_s += backoff
+            self._retrying[fid] = _ScheduledRetry(
+                fid=fid, due_t=now + backoff, from_replica=rep.index)
+            self.obs.emit("fleet.backoff", id=fid, attempts=entry.attempts,
+                          backoff_s=round(backoff, 4),
+                          from_replica=rep.index)
+        self._update_gauges()
+
+    def _backoff_s(self, fid: int, attempts: int) -> float:
+        """Capped exponential backoff with deterministic seeded jitter in
+        ``[0.5x, 1.0x)`` — a pure function of (cfg.seed, fid, attempts),
+        so a replayed trace backs off identically."""
+        base = self.cfg.serve_resubmit_backoff_s
+        if base <= 0:
+            return 0.0
+        raw = min(base * (2.0 ** (attempts - 1)),
+                  self.cfg.serve_resubmit_backoff_max_s)
+        j = ((fid * 1103515245 + attempts * 12345
+              + self.cfg.seed * 2654435761) >> 7) % 1024
+        return raw * (0.5 + 0.5 * (j / 1024.0))
+
+    def _flush_retries(self) -> None:
+        """Re-route scheduled resubmissions whose backoff has elapsed.
+        When the fleet is otherwise quiescent the remaining backoff is
+        collapsed — delaying a retry the survivors could serve *right now*
+        protects nothing, and drain() must terminate under any clock."""
+        if not self._retrying:
+            return
+        now = self.clock()
+        idle = not any(
+            (rep.engine.occupancy or rep.engine.queue_depth)
+            for rep in self.replicas
+            if not rep.closed and rep.health != SICK)
+        for fid in sorted(self._retrying):
+            item = self._retrying[fid]
+            if item.due_t > now and not idle:
+                continue
+            del self._retrying[fid]
+            entry = self._pending.get(fid)
+            if entry is None:
+                continue  # result already consumed
+            if entry.deadline_t is not None and entry.deadline_t <= now:
+                self._pending.pop(fid, None)
+                continue  # would expire on arrival: the SHED stands
             target = self.router.pick(self.replicas)
             if target is None:
                 self._pending.pop(fid, None)
                 continue  # nowhere to go: the SHED stands
-            now = self.clock()
-            if entry.deadline_t is not None and entry.deadline_t <= now:
-                self._pending.pop(fid, None)
-                continue  # would expire on arrival
             ddl = (entry.deadline_t - now
                    if entry.deadline_t is not None else 0)
             eid2 = target.engine.submit(
                 entry.sample, max_new_tokens=entry.max_new_tokens,
-                deadline_s=ddl)
+                deadline_s=ddl, priority=entry.priority)
             self._routes[fid] = (target.index, eid2)
             self.resubmissions += 1
             self._m_resubmitted.inc()
             self.obs.emit("fleet.resubmit", id=fid, replica=target.index,
-                          engine_id=eid2, from_replica=rep.index)
-        self._update_gauges()
+                          engine_id=eid2, from_replica=item.from_replica,
+                          attempts=entry.attempts,
+                          backoff_s=round(entry.backoff_s, 4))
 
     def _update_gauges(self) -> None:
         self._m_healthy.set(len(self.healthy_replicas))

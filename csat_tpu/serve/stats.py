@@ -89,6 +89,8 @@ _METRICS = {
                "FAILED outcomes (NaN logits, stuck slot, device fault, poison)"),
     "quarantined": ("counter", "serve_requests_quarantined_total",
                     "poison submits (subset of failed)"),
+    "browned": ("counter", "serve_requests_browned_total",
+                "low-tier requests brownout-capped at admission"),
     "reaped": ("counter", "serve_slots_reaped_total",
                "stuck slots force-retired by the reaper"),
     "rebuilds": ("counter", "serve_pool_rebuilds_total",
@@ -132,6 +134,7 @@ class ServeStats:
     failed = _Backed()          # NaN logits, stuck slot, prefill/device
     #                             fault, poison submit — every FAILED outcome
     quarantined = _Backed()     # poison subset of `failed` (submit-time)
+    browned = _Backed()         # low-tier decode budgets capped by brownout
     reaped = _Backed()          # stuck slots force-retired by the reaper
     rebuilds = _Backed()        # slot-pool rebuilds after a device fault
     decode_steps = _Backed()    # engine ticks that ran the decode program
@@ -264,6 +267,7 @@ class ServeStats:
             "timeouts": self.timeouts,
             "failed": self.failed,
             "quarantined": self.quarantined,
+            "browned": self.browned,
             "reaped": self.reaped,
             "rebuilds": self.rebuilds,
             "decode_steps": self.decode_steps,

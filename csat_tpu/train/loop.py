@@ -643,24 +643,40 @@ class Trainer:
         with use_mesh(self.mesh):
             return self._fit(train_ds, val_ds, num_epochs, checkpoint_fn, resume)
 
+    def _stop_requested(self, preempt) -> bool:
+        """Consensus form of ``preempt.triggered``: on one process it IS
+        the local flag; on a multi-process topology it is the global OR
+        (``coordinated_trigger``), so a SIGTERM delivered to a subset of
+        hosts stops every host at the same step boundary — the collective
+        preemption save below must be entered by all hosts or none."""
+        if jax.process_count() <= 1:
+            return preempt.triggered
+        from csat_tpu.resilience.preemption import coordinated_trigger
+
+        return coordinated_trigger(preempt)
+
     def _preempt_save(self, ck_dir: str, state: TrainState, epoch: int,
                       it_done: int) -> None:
         """Final synchronous snapshot + resume marker (the SIGTERM path).
 
         Runs under bounded retry — the grace window is short, but one
-        flaky-filesystem blip must not cost the whole snapshot. Multi-host
-        caveat: orbax saves are collective, so this relies on every host
-        receiving the signal (the usual preemption contract); coordinated
-        abort for partial signals is a ROADMAP open item."""
+        flaky-filesystem blip must not cost the whole snapshot. The orbax
+        save is collective, so it is gated behind ``abort_barrier``: every
+        host rendezvouses here (having agreed to stop via
+        ``coordinated_trigger``) before any host touches orbax — a partial
+        SIGTERM can no longer start a torn collective save."""
         from csat_tpu.resilience.preemption import (
-            preempt_dir, snapshot_step, write_resume_marker,
+            abort_barrier, preempt_dir, snapshot_step, write_resume_marker,
         )
         from csat_tpu.resilience.retry import retry
         from csat_tpu.train.checkpoint import save_state
 
+        synced = abort_barrier("preempt_save")
         self.log(f"preemption: saving synchronous snapshot "
-                 f"(epoch {epoch}, {it_done} iterations done) under {ck_dir}")
-        self.obs.emit("fault.preemption", epoch=epoch, it_done=it_done)
+                 f"(epoch {epoch}, {it_done} iterations done) under {ck_dir} "
+                 f"[abort sync: {synced}]")
+        self.obs.emit("fault.preemption", epoch=epoch, it_done=it_done,
+                      abort_sync=synced)
         with self.obs.span("train.checkpoint"):
             retry(save_state, preempt_dir(ck_dir), state,
                   snapshot_step(epoch, it_done),
@@ -847,7 +863,7 @@ class Trainer:
                     probe=probe,
                     on_trip=self._watchdog_trip))
             for epoch in range(start_epoch, num_epochs + 1):
-                if preempt.triggered:
+                if self._stop_requested(preempt):
                     # signal arrived between epochs (validation/checkpoint
                     # phase): snapshot at the epoch boundary
                     self._preempt_save(ck_dir, state, epoch, 0)
@@ -947,7 +963,7 @@ class Trainer:
                         if injector is not None:
                             injector.fire_preemption(global_step, preempt)
                         global_step += 1
-                        if preempt.triggered:
+                        if self._stop_requested(preempt):
                             if watchdog is not None:
                                 watchdog.disarm()
                             self._preempt_save(ck_dir, state, epoch, it_done)

@@ -67,12 +67,12 @@ def test_alive_tpu_best_variant_wins(bench, monkeypatch, capsys):
     monkeypatch.setattr(bench, "_run_child", fake_child)
     out = _run_main(bench, capsys)
     assert out["device"] == "tpu"
-    # the 4th variant wins: the 5th-7th (bucketed 104, serve 105, fleet
-    # 106) are excluded from the headline pool — vs_baseline stays defined
-    # on the padded-credit fixed-shape protocol
+    # the 4th variant wins: the 5th-8th (bucketed 104, serve 105, fleet
+    # 106, chaos 107) are excluded from the headline pool — vs_baseline
+    # stays defined on the padded-credit fixed-shape protocol
     assert out["value"] == 103.0
     assert "degraded" not in out
-    assert len(out["all_variants"]) == 7
+    assert len(out["all_variants"]) == 8
     # one probe + ONE serve for the whole device group (single claim)
     assert [c[0] for c in calls] == ["--probe", "--serve"]
 
@@ -197,6 +197,71 @@ def test_fleet_record_fields_survive_embedding(bench, monkeypatch, capsys):
             assert v[k] == want, (k, v)
 
 
+def test_chaos_record_fields_survive_embedding(bench, monkeypatch, capsys):
+    """A chaos-mode child record's drill fields (trace/plan identity,
+    invariant verdict, per-class p95, brownout/shed counts, the 1.5x
+    high-priority SLO ratio) must survive into the final JSON's
+    all_variants — they carry the ISSUE 12 chaos-proving-ground claim."""
+    chaos_fields = {"trace": "bursty_multitenant",
+                    "fault_plan": ["nan_logits", "wedge_slot",
+                                   "retire_replica"],
+                    "chaos_violations": 0, "invariant_checks": 27,
+                    "capacity_frac": 0.5,
+                    "per_class_p95": {"gold": 0.9, "silver": 1.4,
+                                      "batch": 2.2},
+                    "high_p95_uncontended_s": 0.7,
+                    "high_p95_overload_s": 1.0, "high_p95_ratio": 1.43,
+                    "brownout_capped": 10, "low_priority_shed": 4,
+                    "poison_budget_hits": 0, "resubmissions": 3,
+                    "outcomes": {"OK": 5, "SHED": 4, "FAILED": 3}}
+
+    def fake_child(args, timeout_s, cpu_only=False):
+        if args[0] == "--probe":
+            return {"ok": True, "platform": "tpu", "n_devices": 1}, None
+        for spec in args[1].split(","):
+            _emit(bench, {"phase": "start", "spec": spec})
+            rec = _result(spec, 100.0)
+            if rec["mode"] == "chaos":
+                rec.update(chaos_fields, nonterminal_after_drain=0)
+            _emit(bench, rec)
+        _emit(bench, {"phase": "done"})
+        return {"ok": True, "phase": "done"}, None
+
+    monkeypatch.setattr(bench, "_run_child", fake_child)
+    out = _run_main(bench, capsys)
+    chaos_recs = [v for v in out["all_variants"] if v["mode"] == "chaos"]
+    assert chaos_recs, "spec list must carry a chaos variant"
+    for v in chaos_recs:
+        for k, want in chaos_fields.items():
+            assert v[k] == want, (k, v)
+    assert "degraded" not in out  # zero violations: artifact stays clean
+
+
+def test_chaos_violations_mark_artifact_degraded(bench, monkeypatch, capsys):
+    """Any invariant violation in the chaos drill must degrade the WHOLE
+    artifact with an explicit note — a dirty chaos run never publishes
+    silently (same loud-failure posture as pallas parity divergence)."""
+
+    def fake_child(args, timeout_s, cpu_only=False):
+        if args[0] == "--probe":
+            return {"ok": True, "platform": "tpu", "n_devices": 1}, None
+        for spec in args[1].split(","):
+            _emit(bench, {"phase": "start", "spec": spec})
+            rec = _result(spec, 100.0)
+            if rec["mode"] == "chaos":
+                rec.update(chaos_violations=2,
+                           violation_invariants=["page_leak",
+                                                 "exactly_one_terminal"])
+            _emit(bench, rec)
+        _emit(bench, {"phase": "done"})
+        return {"ok": True, "phase": "done"}, None
+
+    monkeypatch.setattr(bench, "_run_child", fake_child)
+    out = _run_main(bench, capsys)
+    assert out["degraded"] is True
+    assert "chaos" in out.get("notes", "")
+
+
 def test_killed_serve_retries_untried_first(bench, monkeypatch, capsys):
     """A serve child killed mid-variant: the retry round runs the missing
     specs with the killed one LAST, and the final JSON carries both the
@@ -225,7 +290,7 @@ def test_killed_serve_retries_untried_first(bench, monkeypatch, capsys):
     monkeypatch.setattr(bench, "_run_child", fake_child)
     out = _run_main(bench, capsys)
     assert state["round"] == 2
-    assert len(out["all_variants"]) == 7
+    assert len(out["all_variants"]) == 8
     assert out["value"] == 300.0
     assert "killed during" not in out.get("notes", "")  # retried successfully
 
@@ -251,7 +316,7 @@ def test_deterministic_error_not_retried(bench, monkeypatch, capsys):
     out = _run_main(bench, capsys)
     assert state["serves"] == 1  # error is final: no retry round
     assert "non-finite" in out["notes"]
-    assert len(out["all_variants"]) == 6
+    assert len(out["all_variants"]) == 7
 
 
 def test_malformed_bench_variants_flagged(bench, monkeypatch, capsys):
@@ -293,7 +358,7 @@ def test_done_record_authoritative_over_stdout_marker(bench, monkeypatch, capsys
     out = _run_main(bench, capsys)
     assert state["serves"] == 1  # done record suppressed the retry round
     assert "serve:" not in out.get("notes", "")
-    assert len(out["all_variants"]) == 7
+    assert len(out["all_variants"]) == 8
     assert "degraded" not in out
 
 

@@ -1,0 +1,406 @@
+"""Chaos proving ground (ISSUE 12 tentpole).
+
+Pins the four contracts of the adversarial-traffic / fault-schedule layer:
+
+* **traffic zoo** — a trace is a pure function of ``(seed, spec)``:
+  bit-identical regeneration, JSON spec/trace round-trips, replayability
+  with loud divergence detection, and the advertised adversarial shapes
+  (poison mix, duplicate storm with a shared hot set, max-heavy length
+  skew, weighted multi-tenant tiers) actually present in the output;
+* **FaultPlan DSL** — plans serialize/deserialize, relative offsets
+  compile against the target's current clocks, invalid targets fail at
+  ``apply`` time (replica-targeted or retire plans on a bare engine, two
+  hangs on one replica), and :meth:`FaultPlan.random` storms stay
+  drainable by construction (no ``hang``, replica 0 never retired);
+* **invariant monitors** — token-identity violations are structured,
+  ``assert_clean`` dumps a postmortem and raises; a clean drill run under
+  the monitor records checks and zero violations;
+* **chaos drills** (``-m chaos``) — poison-flood, duplicate-storm and
+  injected-fault traces driven end-to-end through :func:`run_chaos` on a
+  live engine leave every request with exactly one terminal status and
+  the invariants intact; SLO-aware degradation (brownout caps, priority
+  shedding, retry_after hints) engages under a tight queue; a slow
+  randomized storm property test crosses seeded random plans with zoo
+  traces on a 2-replica fleet and demands a clean strict run every time.
+"""
+
+import json
+import types
+
+import numpy as np
+import pytest
+
+from csat_tpu.data.toy import random_request_sample
+from csat_tpu.resilience import (
+    FaultEvent,
+    FaultPlan,
+    InvariantMonitor,
+    InvariantViolationError,
+    run_chaos,
+)
+from csat_tpu.resilience.chaos import KINDS
+from csat_tpu.serve import (
+    TRACE_ZOO,
+    Fleet,
+    RequestStatus,
+    ServeEngine,
+    TraceSpec,
+    collate_requests,
+    make_trace,
+    replay,
+    zoo_spec,
+)
+
+SRC_V, TGT_V, TRIP_V = 200, 300, 50
+
+
+@pytest.fixture(scope="module")
+def chaos_cfg(micro_config):
+    """Deterministic micro config on the bit-identity paths, 2 slots over a
+    single prefill bucket (fewest programs), three tenant tiers."""
+    return micro_config.replace(
+        full_att=True, dropout=0.0, attention_dropout=0.0,
+        cse_empty_rows="zero", serve_slots=2,
+        bucket_src_lens=(48,), serve_priority_classes=3,
+    )
+
+
+@pytest.fixture(scope="module")
+def stack(chaos_cfg):
+    """(cfg, model, params) shared by the module; engines are per-test."""
+    from csat_tpu.train.state import create_train_state, default_optimizer, make_model
+
+    cfg = chaos_cfg
+    model = make_model(cfg, SRC_V, TGT_V, TRIP_V)
+    warm = collate_requests(
+        [random_request_sample(cfg, SRC_V, TRIP_V, 8, seed=0)],
+        cfg.max_src_len, 1, cfg, tgt_width=cfg.max_tgt_len - 1)
+    params = create_train_state(
+        model, default_optimizer(cfg), warm, seed=0).params
+    return cfg, model, params
+
+
+def _requests(cfg, n, seed=0, lo=5):
+    rng = np.random.default_rng(seed)
+    return [
+        random_request_sample(cfg, SRC_V, TRIP_V, int(ln), seed=1000 * seed + i)
+        for i, ln in enumerate(rng.integers(lo, cfg.max_src_len, n))
+    ]
+
+
+def _samples_equal(a, b):
+    return (set(a) == set(b)
+            and all(np.array_equal(a[k], b[k]) for k in a))
+
+
+# ---------------------------------------------------------------------------
+# traffic zoo: determinism, serialization, adversarial shapes
+# ---------------------------------------------------------------------------
+
+
+def test_zoo_specs_and_json_roundtrip():
+    assert sorted(TRACE_ZOO) == [
+        "adversarial", "bursty_multitenant", "diurnal", "duplicate_storm",
+        "length_skew", "poison_flood", "steady",
+    ]
+    spec = zoo_spec("adversarial", 16, seed=3)
+    assert (spec.name, spec.n_requests, spec.seed) == ("adversarial", 16, 3)
+    assert TraceSpec.from_json(spec.to_json()) == spec
+    # every zoo entry round-trips (classes tuples included)
+    for name in TRACE_ZOO:
+        s = zoo_spec(name, 8, seed=1)
+        assert TraceSpec.from_json(s.to_json()) == s
+    # the spec validates itself
+    with pytest.raises(AssertionError):
+        TraceSpec(arrival="nope")
+    with pytest.raises(AssertionError):
+        TraceSpec(length_skew="nope")
+    with pytest.raises(AssertionError):
+        TraceSpec(poison_frac=0.6, duplicate_frac=0.5)
+    with pytest.raises(AssertionError):
+        TraceSpec(mean_interarrival=0.0)
+
+
+def test_trace_deterministic_and_replayable(chaos_cfg):
+    cfg = chaos_cfg
+    spec = zoo_spec("adversarial", 24, seed=7)
+    t1 = make_trace(spec, cfg, SRC_V, TRIP_V)
+    t2 = make_trace(spec, cfg, SRC_V, TRIP_V)
+    assert [it.meta() for it in t1.items] == [it.meta() for it in t2.items]
+    for a, b in zip(t1.items, t2.items):
+        assert _samples_equal(a.sample, b.sample)
+
+    arrivals = [it.arrival for it in t1.items]
+    assert arrivals == sorted(arrivals) and arrivals[0] >= 0
+    # the adversarial mix is actually adversarial
+    assert t1.n_poison > 0 and t1.n_duplicates > 0
+    assert set(t1.by_class()) == {"gold", "silver", "batch"}
+    assert {it.priority for it in t1.items} == {0, 1, 2}
+    # duplicates repeat an earlier hot item byte-identically
+    for it in t1.items:
+        if it.kind == "duplicate":
+            ref = t1.items[it.dup_of]
+            assert it.dup_of < it.index and ref.kind == "normal"
+            assert _samples_equal(it.sample, ref.sample)
+        if it.kind == "poison":
+            assert it.poison_mode != ""
+
+    # a dumped trace IS the repro; tampered metadata fails loudly
+    t3 = replay(t1.to_json(), cfg, SRC_V, TRIP_V)
+    assert [it.meta() for it in t3.items] == [it.meta() for it in t1.items]
+    d = json.loads(t1.to_json())
+    d["items"][0]["n_real"] += 1
+    with pytest.raises(ValueError, match="diverged"):
+        replay(json.dumps(d), cfg, SRC_V, TRIP_V)
+    # and so does a different cfg shape (the spec no longer matches)
+    with pytest.raises(ValueError, match="diverged"):
+        replay(t1.to_json(), cfg.replace(max_src_len=24), SRC_V, TRIP_V)
+
+
+def test_length_skew_floods_the_top_bucket(chaos_cfg):
+    cfg = chaos_cfg
+    trace = make_trace(zoo_spec("length_skew", 32, seed=1), cfg, SRC_V, TRIP_V)
+    at_max = sum(1 for it in trace.items if it.n_real == cfg.max_src_len)
+    assert at_max >= len(trace) // 2  # max_heavy: ~80% land on max_src_len
+
+
+# ---------------------------------------------------------------------------
+# FaultPlan DSL: serialization, random storms, compilation guards
+# ---------------------------------------------------------------------------
+
+
+def test_fault_plan_roundtrip_and_validation():
+    plan = FaultPlan((
+        FaultEvent("nan_logits", at=2, slot=1),
+        FaultEvent("decode_fault", at=4, count=2),
+        FaultEvent("retire_replica", at=3, replica=1),
+    ), name="p")
+    assert FaultPlan.from_json(plan.to_json()) == plan
+    with pytest.raises(AssertionError):
+        FaultEvent("melt_down")
+    with pytest.raises(AssertionError):
+        FaultEvent("nan_logits", at=-1)
+    with pytest.raises(AssertionError):
+        FaultEvent("decode_fault", count=0)
+
+
+def test_random_storms_stay_drainable_by_construction():
+    for seed in range(8):
+        plan = FaultPlan.random(seed, n_events=4, replicas=2, slots=2)
+        assert len(plan.events) == 4
+        for e in plan.events:
+            assert e.kind in KINDS and e.kind != "hang"
+            assert not (e.kind == "retire_replica" and e.replica == 0)
+            assert e.at >= 1 and e.replica in (0, 1)
+    # single-replica storms never retire (nothing could absorb the work)
+    for seed in range(8):
+        plan = FaultPlan.random(seed, n_events=4, replicas=1, slots=4)
+        assert all(e.kind not in ("retire_replica", "reap_storm")
+                   and e.replica == 0 for e in plan.events)
+
+
+def test_fault_plan_apply_guards(chaos_cfg):
+    bare = types.SimpleNamespace()  # no .replicas: treated as a bare engine
+    with pytest.raises(ValueError, match="bare engine"):
+        FaultPlan((FaultEvent("nan_logits", replica=1),)).apply(bare)
+    with pytest.raises(ValueError, match="Fleet target"):
+        FaultPlan((FaultEvent("retire_replica"),)).apply(bare)
+    eng = types.SimpleNamespace(ticks=5, prefills=2, cfg=chaos_cfg)
+    with pytest.raises(ValueError, match="one hang"):
+        FaultPlan((FaultEvent("hang", at=1, seconds=1.0),
+                   FaultEvent("hang", at=3, seconds=1.0))).apply(eng)
+    # offsets compile against the target's CURRENT clocks
+    installed = FaultPlan((
+        FaultEvent("nan_logits", at=2, slot=1),
+        FaultEvent("prefill_fail", at=3),
+    ), name="rel").apply(eng)
+    inj = installed[0]
+    assert eng.fault_injector is inj
+    assert inj.serve_nan_logits == {7: 1}             # ticks 5 + at 2
+    assert 5 in inj.serve_prefill_fail_calls          # prefills 2 + at 3
+
+
+# ---------------------------------------------------------------------------
+# invariant monitors
+# ---------------------------------------------------------------------------
+
+
+def test_monitor_bit_identity_violation_and_postmortem(chaos_cfg, tmp_path):
+    mon = InvariantMonitor(chaos_cfg, postmortem_dir=str(tmp_path))
+    ok = np.array([1, 2, 3])
+    mon.check_tokens({1: ok, 2: np.array([4])},
+                     {1: ok, 2: np.array([4, 5])})
+    mon.check_tokens({3: ok}, {})  # missing id entirely
+    assert [v.invariant for v in mon.violations] == ["bit_identity"] * 2
+    with pytest.raises(InvariantViolationError) as ei:
+        mon.assert_clean()
+    assert len(ei.value.violations) == 2
+    dumped = json.loads(
+        (tmp_path / "postmortem_chaos_violations.json").read_text())
+    assert len(dumped["violations"]) == 2
+
+    clean = InvariantMonitor(chaos_cfg)
+    clean.check_tokens({1: ok}, {1: np.array(ok)})
+    clean.assert_clean()  # no violations: a no-op
+    assert clean.violations == []
+
+
+# ---------------------------------------------------------------------------
+# chaos drills: run_chaos end-to-end on a live engine
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.chaos
+def test_poison_flood_drill(stack, tmp_path):
+    """30% malformed intake: every poison quarantines to FAILED at submit,
+    clean requests finish OK, the invariants hold, and the dumped timeline
+    renders through tools/chaos_report.py with a zero (clean) exit."""
+    cfg, model, params = stack
+    eng = ServeEngine(model, params, cfg, sample_seed=0)
+    trace = make_trace(zoo_spec("poison_flood", 12, seed=5), cfg, SRC_V, TRIP_V)
+    mon = InvariantMonitor(cfg, postmortem_dir=str(tmp_path))
+    report = run_chaos(eng, trace, monitor=mon, strict=True)
+
+    assert report.clean and report.checks > 0
+    assert report.outcomes.get("FAILED", 0) == trace.n_poison > 0
+    assert report.outcomes.get("OK", 0) == len(trace) - trace.n_poison
+    assert report.poison_budget_hits == 0  # budget (64) not exhausted
+    assert eng.stats.quarantined == trace.n_poison
+    assert eng.occupancy == 0 and eng.queue_depth == 0
+
+    # the artifact round-trips through the renderer and reads as clean
+    import importlib.util, os
+    path = report.dump(str(tmp_path / "chaos_run.jsonl"))
+    spec = importlib.util.spec_from_file_location(
+        "chaos_report", os.path.join(
+            os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+            "tools", "chaos_report.py"))
+    chaos_report = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(chaos_report)
+    assert chaos_report.main([path]) == 0
+    meta, events = chaos_report.load_dump(path)
+    assert meta["trace"] == "poison_flood" and meta["violations"] == 0
+    assert sum(1 for e in events if e["name"] == "fault.poison") == trace.n_poison
+    eng.close()
+
+
+@pytest.mark.chaos
+def test_duplicate_storm_drill(stack):
+    """A 60% duplicate storm: everything completes OK, duplicates decode
+    bit-identically to their hot originals, and the refcounted prefix
+    cache absorbed repeats (hits recorded, no leak on drain)."""
+    cfg, model, params = stack
+    eng = ServeEngine(model, params, cfg, sample_seed=0)
+    trace = make_trace(
+        zoo_spec("duplicate_storm", 12, seed=6, mean_interarrival=2.0),
+        cfg, SRC_V, TRIP_V)
+    assert trace.n_duplicates > 0
+    mon = InvariantMonitor(cfg)
+    report = run_chaos(eng, trace, monitor=mon, strict=True)
+
+    assert report.clean
+    assert report.outcomes == {"OK": len(trace)}
+    assert eng.stats.prefix_hits > 0
+
+    # fresh engine: ids are 0..n-1 in item order, so duplicate items must
+    # have decoded the exact token stream of the hot item they repeat
+    expected, got = {}, {}
+    for it in trace.items:
+        if it.kind == "duplicate":
+            expected[it.index] = np.asarray(eng.poll(it.dup_of).tokens)
+            got[it.index] = np.asarray(eng.poll(it.index).tokens)
+    assert expected
+    mon.check_tokens(expected, got)
+    mon.assert_clean()
+    eng.close()
+
+
+@pytest.mark.chaos
+def test_fault_plan_drill_on_engine(stack):
+    """A steady trace under an injected nan+wedge plan: the afflicted
+    requests fail structurally, the pool keeps serving the rest, and the
+    strict invariant check passes."""
+    cfg, model, params = stack
+    eng = ServeEngine(model, params, cfg, sample_seed=0)
+    # near-simultaneous arrivals keep both slots occupied from tick 1 on,
+    # so the scheduled faults are guaranteed to find victims
+    trace = make_trace(
+        zoo_spec("steady", 8, seed=4, mean_interarrival=0.1),
+        cfg, SRC_V, TRIP_V)
+    plan = FaultPlan((
+        FaultEvent("nan_logits", at=2, slot=0),
+        FaultEvent("wedge_slot", at=4, slot=1),
+    ), name="nan_wedge")
+    mon = InvariantMonitor(cfg)
+    report = run_chaos(eng, trace, plan=plan, monitor=mon, strict=True)
+
+    assert report.clean and report.plan_name == "nan_wedge"
+    assert report.outcomes.get("FAILED", 0) >= 1   # nan guard + reaper
+    assert report.outcomes.get("OK", 0) >= 1       # the pool kept serving
+    assert sum(report.outcomes.values()) == len(trace)
+    names = {e["name"] for e in report.timeline}
+    assert "fault.injected.nan_logits" in names
+    assert report.plan_json and FaultPlan.from_json(report.plan_json) == plan
+    eng.close()
+
+
+@pytest.mark.chaos
+def test_brownout_priority_shed_and_retry_hints(stack):
+    """SLO-aware degradation under a tight queue: low tiers lose decode
+    budget first (browned), shedding never evicts a more important
+    request, gold rides through untouched, and every refusal carries a
+    queue-scaled retry_after_s hint."""
+    cfg, model, params = stack
+    tight = cfg.replace(
+        serve_max_queue=4, serve_queue_policy="shed_oldest",
+        serve_brownout_queue_frac=0.5, serve_brownout_max_new_tokens=2,
+        serve_retry_after_s=0.25)
+    eng = ServeEngine(model, params, tight, sample_seed=0)
+    samples = _requests(cfg, 12, seed=9)
+    ids = [eng.submit(s, priority=i % 3) for i, s in enumerate(samples)]
+    results = eng.drain()
+
+    assert eng.occupancy == 0 and eng.queue_depth == 0
+    reqs = [results[i] for i in ids]
+    assert all(r.status in RequestStatus.TERMINAL for r in reqs)
+
+    browned = [r for r in reqs if r.browned]
+    assert browned and eng.stats.browned == len(browned)
+    assert all(r.priority > 0 for r in browned)
+    assert all(r.n_tokens <= 2 for r in browned if r.status == RequestStatus.OK)
+
+    shed = [r for r in reqs if r.status == RequestStatus.SHED]
+    assert shed and all(r.priority > 0 for r in shed)
+    # gold never degrades: full budget, never shed
+    assert all(r.status == RequestStatus.OK and not r.browned
+               for r in reqs if r.priority == 0)
+    # structured backpressure: base 0.25 scaled up by queue depth
+    assert all(r.retry_after_s is not None and r.retry_after_s >= 0.25
+               for r in shed)
+
+    mon = InvariantMonitor(tight)
+    assert mon.check(eng, results=results, expected_ids=ids) == []
+    eng.close()
+
+
+@pytest.mark.slow
+@pytest.mark.chaos
+def test_randomized_storm_property(stack):
+    """Seeded random fault storms x zoo traces on a 2-replica fleet: every
+    combination must drain to exactly-one-terminal-per-request with zero
+    invariant violations (strict run_chaos raises otherwise)."""
+    cfg, model, params = stack
+    traces = ("bursty_multitenant", "poison_flood", "duplicate_storm")
+    for seed in range(3):
+        fleet = Fleet(model, params, cfg, replicas=2, sample_seed=0)
+        plan = FaultPlan.random(seed, n_events=3, replicas=2,
+                                slots=cfg.serve_slots)
+        trace = make_trace(
+            zoo_spec(traces[seed % len(traces)], 10, seed=100 + seed),
+            cfg, SRC_V, TRIP_V)
+        mon = InvariantMonitor(cfg)
+        report = run_chaos(fleet, trace, plan=plan, monitor=mon, strict=True)
+        assert report.clean and report.checks > 0
+        assert "UNRESOLVED" not in report.outcomes
+        assert sum(report.outcomes.values()) == len(trace)
+        fleet.close()

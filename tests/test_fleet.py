@@ -23,7 +23,7 @@ import numpy as np
 import pytest
 
 from csat_tpu.data.toy import random_request_sample
-from csat_tpu.resilience import FaultInjector
+from csat_tpu.resilience import FaultEvent, FaultPlan
 from csat_tpu.serve import (
     DRAINING,
     HEALTHY,
@@ -103,10 +103,8 @@ def test_sick_replica_drill_isolated_and_bit_identical(stack):
     fleet.tick()
     # decode faults on replica 1 from its next tick on; rebuild cap 0 means
     # the first one exhausts the engine's self-healing and the fleet
-    # retires the replica
-    fleet.replicas[1].engine.fault_injector = FaultInjector(
-        serve_decode_fail_ticks=frozenset(
-            range(fleet.ticks, fleet.ticks + 10_000)))
+    # retires the replica (ISSUE 12: drills go through the FaultPlan path)
+    FaultPlan((FaultEvent("retire_replica", at=0, replica=1),)).apply(fleet)
     results = fleet.drain()
 
     assert fleet.replicas[1].health == SICK
@@ -149,11 +147,15 @@ def test_resubmission_moves_queued_work_to_healthy_replica(stack):
     before = dict(fleet.routes)
     on_r1 = [fid for fid, ri in before.items() if ri == 1]
     fleet.tick()
-    fleet.replicas[1].engine.fault_injector = FaultInjector(
-        serve_decode_fail_ticks=frozenset(
-            range(fleet.ticks, fleet.ticks + 10_000)))
+    FaultPlan((FaultEvent("retire_replica", at=0, replica=1),)).apply(fleet)
     results = fleet.drain()
     assert fleet.resubmissions > 0
+    # every resubmission rode the capped-backoff path and stamped its
+    # terminal record (ISSUE 12 satellite)
+    assert all(results[fid].attempts >= 1 and results[fid].backoff_s > 0
+               for fid in fleet.routes if results[fid].status ==
+               RequestStatus.OK and fleet.routes[fid] == 0
+               and dict(before)[fid] == 1)
     # moved requests now route to replica 0 and completed there
     moved = [fid for fid in on_r1 if fleet.routes.get(fid) == 0]
     assert len(moved) == fleet.resubmissions

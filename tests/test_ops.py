@@ -441,6 +441,55 @@ class TestStaticFleetBoundary:
         assert not offenders, offenders
 
 
+class TestStaticChaosBoundary:
+    """Chaos-layer boundary (ISSUE 12 satellite): the traffic zoo, the
+    FaultPlan compiler and the invariant monitors drive the serve stack
+    strictly through its public API — no ``obj._name`` reach-through into
+    engine/fleet/injector internals — and :meth:`FaultPlan.apply` compiles
+    onto the :class:`FaultInjector` ctor's PUBLIC hook kwargs only, so an
+    injector-surface rename breaks here, not silently at drill time."""
+
+    ROOT = pathlib.Path(__file__).resolve().parent.parent
+    FILES = ("csat_tpu/serve/traffic.py", "csat_tpu/resilience/chaos.py",
+             "csat_tpu/resilience/invariants.py")
+
+    def test_no_private_attribute_reach_through(self):
+        offenders = []
+        for rel in self.FILES:
+            path = self.ROOT / rel
+            tree = ast.parse(path.read_text(), filename=str(path))
+            for node in ast.walk(tree):
+                if (isinstance(node, ast.Attribute)
+                        and node.attr.startswith("_")
+                        and not node.attr.startswith("__")
+                        and not (isinstance(node.value, ast.Name)
+                                 and node.value.id == "self")):
+                    offenders.append(f"{rel}:{node.lineno} .{node.attr}")
+        assert not offenders, offenders
+
+    def test_fault_plan_compiles_onto_public_injector_kwargs(self):
+        import inspect
+
+        from csat_tpu.resilience.faults import FaultInjector
+
+        path = self.ROOT / "csat_tpu/resilience/chaos.py"
+        tree = ast.parse(path.read_text(), filename=str(path))
+        calls = [
+            node for node in ast.walk(tree)
+            if isinstance(node, ast.Call)
+            and isinstance(node.func, ast.Name)
+            and node.func.id == "FaultInjector"
+        ]
+        assert calls, "FaultPlan.apply must construct a FaultInjector"
+        params = inspect.signature(FaultInjector.__init__).parameters
+        for call in calls:
+            assert not call.args, "hooks must be passed by keyword"
+            for kw in call.keywords:
+                assert kw.arg in params, (
+                    f"chaos.py:{call.lineno} passes {kw.arg!r}, not a "
+                    f"FaultInjector ctor kwarg")
+
+
 @pytest.mark.slow
 def test_model_backend_pallas_matches_xla_forward():
     """Full CSATrans forward with backend=pallas == backend=xla (same rngs)."""

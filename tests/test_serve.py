@@ -30,7 +30,13 @@ import numpy as np
 import pytest
 
 from csat_tpu.data.toy import random_request_sample
-from csat_tpu.resilience import DataErrorBudgetExceeded, ErrorBudget, FaultInjector
+from csat_tpu.resilience import (
+    DataErrorBudgetExceeded,
+    ErrorBudget,
+    FaultEvent,
+    FaultInjector,
+    FaultPlan,
+)
 from csat_tpu.serve import (
     PoisonRequestError,
     RequestStatus,
@@ -552,8 +558,7 @@ def test_nan_logits_retire_row_failed_others_exact(drilled):
     the slot serves subsequent requests."""
     cfg, model, params, eng, clock, _ = drilled
     _drill_reset(eng, cfg)
-    t0 = eng._tick_no
-    eng.fault_injector = FaultInjector(serve_nan_logits=[(t0 + 1, 0)])
+    FaultPlan((FaultEvent("nan_logits", at=1, slot=0),)).apply(eng)
     samples = _bucket0_requests(cfg, cfg.serve_slots, seed=5)
     ids = [eng.submit(s, max_new_tokens=6) for s in samples]
     eng.drain()
@@ -591,8 +596,7 @@ def test_stuck_slot_reaped_not_wedged(drilled):
     drain() completes instead of raising, and the pool keeps serving."""
     cfg, model, params, eng, clock, _ = drilled
     _drill_reset(eng, cfg)
-    t0 = eng._tick_no
-    eng.fault_injector = FaultInjector(serve_wedge_slots=[(t0 + 1, 0)])
+    FaultPlan((FaultEvent("wedge_slot", at=1, slot=0),)).apply(eng)
     samples = _bucket0_requests(cfg, cfg.serve_slots, seed=6)
     ids = [eng.submit(s, max_new_tokens=4) for s in samples]
     eng.drain()  # must terminate: the reaper, not the tick bound
@@ -621,8 +625,7 @@ def test_prefill_failure_fails_chunk_pool_still_serving(drilled):
     slots return to the free list and later admissions succeed."""
     cfg, model, params, eng, clock, _ = drilled
     _drill_reset(eng, cfg)
-    p0 = eng._n_prefills
-    eng.fault_injector = FaultInjector(serve_prefill_fail_calls=[p0])
+    FaultPlan((FaultEvent("prefill_fail", at=0),)).apply(eng)
     samples = _bucket0_requests(cfg, 2, seed=8)
     ids = [eng.submit(s, max_new_tokens=3) for s in samples]
     eng.drain()
@@ -650,9 +653,8 @@ def test_device_fault_rebuilds_and_resubmits_bit_identical(drilled):
     delivery per attempt: nothing is emitted twice)."""
     cfg, model, params, eng, clock, _ = drilled
     _drill_reset(eng, cfg)
-    t0 = eng._tick_no
     compiles0 = eng.stats.compiles
-    eng.fault_injector = FaultInjector(serve_decode_fail_ticks=[t0 + 1])
+    FaultPlan((FaultEvent("decode_fault", at=1),)).apply(eng)
     samples = _bucket0_requests(cfg, cfg.serve_slots + 2, seed=9)
     ids = [eng.submit(s, max_new_tokens=4) for s in samples]
     eng.drain()
@@ -678,8 +680,7 @@ def test_device_fault_retries_exhausted_then_cap(drilled):
     and the engine still serves clean traffic afterwards."""
     cfg, model, params, eng, clock, _ = drilled
     _drill_reset(eng, cfg.replace(serve_max_retries=0, serve_max_rebuilds=4))
-    t0 = eng._tick_no
-    eng.fault_injector = FaultInjector(serve_decode_fail_ticks=[t0])
+    FaultPlan((FaultEvent("decode_fault", at=0),)).apply(eng)
     samples = _bucket0_requests(cfg, 2, seed=10)
     ids = [eng.submit(s, max_new_tokens=3) for s in samples]
     eng.drain()
@@ -691,8 +692,7 @@ def test_device_fault_retries_exhausted_then_cap(drilled):
 
     # rebuild cap: past serve_max_rebuilds the fault propagates loud
     _drill_reset(eng, cfg.replace(serve_max_rebuilds=0))
-    t0 = eng._tick_no
-    eng.fault_injector = FaultInjector(serve_decode_fail_ticks=[t0])
+    FaultPlan((FaultEvent("decode_fault", at=0),)).apply(eng)
     eng.submit(samples[0], max_new_tokens=3)
     with pytest.raises(RuntimeError, match="serve_max_rebuilds"):
         eng.drain()
@@ -736,25 +736,35 @@ def test_cli_parse_request_hardened():
     uncaught AttributeError."""
     from csat_tpu.serve.cli import _parse_request
 
-    ext, code, mx, n, err = _parse_request(
+    ext, code, mx, pr, n, err = _parse_request(
         '{"id": "a", "code": "x", "max_new_tokens": 3}\n', 0)
-    assert (ext, code, mx, n, err) == ("a", "x", 3, 0, None)
+    assert (ext, code, mx, pr, n, err) == ("a", "x", 3, 0, 0, None)
 
-    ext, code, mx, n, err = _parse_request("def f(): pass\n", 0)
+    ext, code, mx, pr, n, err = _parse_request("def f(): pass\n", 0)
     assert err is None and code == "def f(): pass" and ext == 0 and n == 1
+    assert pr == 0  # old clients never send priority: highest tier
 
-    ext, code, mx, n, err = _parse_request('"just a string"\n', 5)
+    ext, code, mx, pr, n, err = _parse_request('"just a string"\n', 5)
     assert err is None and code == "just a string" and ext == 5 and n == 6
 
-    _, code, _, _, err = _parse_request("42\n", 0)
+    _, code, _, _, _, err = _parse_request("42\n", 0)
     assert code is None and "JSON object" in err
 
-    ext, code, _, _, err = _parse_request('{"id": 7}\n', 0)
+    ext, code, _, _, _, err = _parse_request('{"id": 7}\n', 0)
     assert ext == 7 and code is None and "code" in err
 
-    _, _, _, _, err = _parse_request(
+    _, _, _, _, _, err = _parse_request(
         '{"code": "x", "max_new_tokens": "lots"}\n', 0)
     assert "max_new_tokens" in err
+
+    # priority: optional int field, echoed through; junk is an error line
+    ext, code, mx, pr, n, err = _parse_request(
+        '{"code": "x", "priority": 2}\n', 0)
+    assert err is None and pr == 2
+    _, _, _, _, _, err = _parse_request('{"code": "x", "priority": "hi"}\n', 0)
+    assert "priority" in err
+    _, _, _, _, _, err = _parse_request('{"code": "x", "priority": -1}\n', 0)
+    assert "priority" in err
 
 
 def test_cli_stdin_line_reader_handles_bursts():
@@ -845,9 +855,7 @@ def test_tick_hang_trips_serve_watchdog(drilled):
     cfg, model, params, eng, clock, tripped = drilled
     _drill_reset(eng, cfg)
     assert not tripped.is_set(), "watchdog tripped spuriously before the drill"
-    t0 = eng._tick_no
-    eng.fault_injector = FaultInjector(
-        serve_hang_at_tick=t0 + 1, hang_seconds=8.0)
+    FaultPlan((FaultEvent("hang", at=1, seconds=8.0),)).apply(eng)
     reqs = eng.generate(_bucket0_requests(cfg, 2, seed=12), max_new_tokens=4)
     eng.fault_injector = None
     assert tripped.is_set(), "hung tick did not trip the serve watchdog"

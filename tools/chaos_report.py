@@ -1,0 +1,158 @@
+"""Render a chaos-run timeline dump (ISSUE 12).
+
+Reads the JSONL artifact :meth:`csat_tpu.resilience.chaos.ChaosReport.dump`
+writes (one ``{"meta": ...}`` header line, then the ts-sorted merged
+timeline of every component recorder — fleet, each replica engine, and the
+invariant monitor) and renders:
+
+* the run header — trace, fault plan, outcome counts, capacity fraction,
+  invariant checks vs violations;
+* a **fault-vs-invariant timeline** — one row per fault event
+  (``fault.*``), degradation event (``req.brownout``,
+  ``fleet.shed_oldest``, ``fleet.retire``, ``fleet.resubmit``,
+  ``fleet.backoff``) and invariant record (``invariant.*``), in time
+  order with per-component attribution;
+* a per-name event census of the full timeline;
+* every ``invariant.violation`` in detail (the postmortem pointer).
+
+Usage::
+
+    python tools/chaos_report.py outputs/postmortem/postmortem_chaos_timeline.jsonl
+    python tools/chaos_report.py --full chaos_run.jsonl   # every event row
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from typing import List, Tuple
+
+# event-name prefixes that make the condensed timeline: injected faults,
+# the degradation ladder acting, and the invariant monitor's verdicts
+TIMELINE_PREFIXES = (
+    "fault.", "invariant.", "req.brownout", "fleet.shed_oldest",
+    "fleet.retire", "fleet.resubmit", "fleet.backoff", "fleet.draining",
+)
+
+
+def load_dump(path: str) -> Tuple[dict, List[dict]]:
+    """(meta, events) from a ChaosReport.dump JSONL artifact."""
+    meta: dict = {}
+    events: List[dict] = []
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            rec = json.loads(line)
+            if "meta" in rec and not events and not meta:
+                meta = rec["meta"]
+            else:
+                events.append(rec)
+    return meta, events
+
+
+def header_lines(meta: dict) -> List[str]:
+    out = ["== chaos run =="]
+    if not meta:
+        return out + ["  (no meta header in dump)"]
+    out.append(f"  trace: {meta.get('trace', '?')}   "
+               f"plan: {meta.get('plan', '?')}   "
+               f"submitted: {meta.get('submitted', '?')}")
+    outcomes = meta.get("outcomes") or {}
+    if outcomes:
+        out.append("  outcomes: " + "  ".join(
+            f"{k}={v}" for k, v in sorted(outcomes.items())))
+    out.append(f"  invariant checks: {meta.get('checks', 0)}   "
+               f"violations: {meta.get('violations', 0)}   "
+               f"capacity_frac: {meta.get('capacity_frac', 1.0)}   "
+               f"resubmissions: {meta.get('resubmissions', 0)}")
+    plan = meta.get("fault_plan")
+    if plan:
+        try:
+            events = json.loads(plan).get("events", ())
+            out.append("  fault plan: " + "; ".join(
+                f"{e['kind']}@+{e['at']}"
+                + (f" r{e['replica']}" if e.get("replica") else "")
+                for e in events))
+        except (ValueError, KeyError):
+            pass
+    verdict = "CLEAN" if not meta.get("violations") else "VIOLATED"
+    out.append(f"  verdict: {verdict}")
+    return out
+
+
+def timeline_lines(events: List[dict], full: bool = False,
+                   limit: int = 200) -> List[str]:
+    """The condensed fault-vs-invariant timeline (or every event with
+    ``full=True``), relative-timestamped from the first event."""
+    rows = [e for e in events
+            if full or any(e.get("name", "").startswith(p)
+                           for p in TIMELINE_PREFIXES)]
+    out = [f"== timeline ({len(rows)} of {len(events)} events) =="]
+    if not rows:
+        return out + ["  (no fault / invariant events in dump)"]
+    t0 = events[0].get("ts", 0.0)
+    shown = rows if len(rows) <= limit else rows[:limit]
+    for e in shown:
+        extra = {k: v for k, v in e.items()
+                 if k not in ("ts", "name", "component", "dur")}
+        fields = ("  " + " ".join(f"{k}={v}" for k, v in sorted(extra.items()))
+                  if extra else "")
+        out.append(f"  +{e.get('ts', t0) - t0:9.4f}s "
+                   f"{e.get('component', '?'):>9} "
+                   f"{e.get('name', '?'):<24}{fields}")
+    if len(rows) > limit:
+        out.append(f"  ... {len(rows) - limit} more (use --limit)")
+    return out
+
+
+def census_lines(events: List[dict]) -> List[str]:
+    counts: dict = {}
+    for e in events:
+        counts[e.get("name", "?")] = counts.get(e.get("name", "?"), 0) + 1
+    out = ["== event census =="]
+    for name in sorted(counts, key=lambda n: (-counts[n], n)):
+        out.append(f"  {counts[name]:6d}  {name}")
+    return out
+
+
+def violation_lines(events: List[dict]) -> List[str]:
+    bad = [e for e in events if e.get("name") == "invariant.violation"]
+    if not bad:
+        return []
+    out = [f"== violations ({len(bad)}) =="]
+    for e in bad:
+        extra = {k: v for k, v in e.items()
+                 if k not in ("ts", "name", "component", "dur")}
+        out.append("  " + json.dumps(extra, sort_keys=True))
+    return out
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("dump", help="ChaosReport.dump JSONL artifact")
+    ap.add_argument("--full", action="store_true",
+                    help="show every timeline event, not just faults/"
+                         "invariants/degradation")
+    ap.add_argument("--limit", type=int, default=200,
+                    help="max timeline rows to print")
+    args = ap.parse_args(argv)
+
+    meta, events = load_dump(args.dump)
+    lines = header_lines(meta)
+    lines += [""] + timeline_lines(events, full=args.full, limit=args.limit)
+    lines += [""] + census_lines(events)
+    bad = violation_lines(events)
+    if bad:
+        lines += [""] + bad
+    print("\n".join(lines))
+    # a dirty run exits nonzero so CI / scripts can gate on the artifact
+    return 1 if (meta.get("violations")
+                 or any(e.get("name") == "invariant.violation"
+                        for e in events)) else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
