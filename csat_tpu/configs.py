@@ -273,6 +273,43 @@ class Config:
     # resubmission (the PR 11 behavior)
     serve_resubmit_backoff_s: float = 0.05
     serve_resubmit_backoff_max_s: float = 2.0
+    # --- elastic fleet + warm start (ISSUE 13: self-healing) ---
+    # persist AOT-serialized serving executables (jax.export) under the
+    # compilation-cache root so a replacement replica skips trace+lower
+    # and cold-starts in seconds (serve/warmstart.py). Off by default:
+    # the store writes files and digests params at engine init
+    serve_warmstart: bool = False
+    # explicit warm-start store directory; "" = <cache root>/warmstart
+    # (CSAT_TPU_NO_CACHE disables the store regardless)
+    serve_warmstart_dir: str = ""
+    # autoscaler band (serve/autoscale.py): heal/scale between these
+    # bounds. serve_max_replicas 0 = use serve_replicas as the ceiling
+    serve_min_replicas: int = 1
+    serve_max_replicas: int = 0
+    # run the metrics-driven supervisor in the serve loop (CLI --autoscale)
+    serve_autoscale: bool = False
+    # evaluate signals every this many fleet ticks (spawning a replica is
+    # expensive — the supervisor must not outpace the drill it observes)
+    serve_autoscale_every_ticks: int = 8
+    # scale-UP pressure signals, any one suffices: fleet queue depth per
+    # healthy slot; worst healthy replica's KV page occupancy; class-0
+    # p95 latency SLO (0 = p95 signal off)
+    serve_autoscale_up_queue_frac: float = 1.5
+    serve_autoscale_up_page_frac: float = 0.85
+    serve_autoscale_p95_slo_s: float = 0.0
+    # scale-DOWN requires BOTH: queue per healthy slot at or under this
+    # AND busy-slot fraction at or under serve_autoscale_down_busy_frac
+    serve_autoscale_down_queue_frac: float = 0.1
+    serve_autoscale_down_busy_frac: float = 0.25
+    # consecutive over/under evaluations before a scale action (healing a
+    # below-target fleet is immediate — only sizing is hysteresis-gated)
+    serve_autoscale_hysteresis: int = 3
+    # minimum wall-clock between scale actions
+    serve_autoscale_cooldown_s: float = 5.0
+    # churn bound: at most this many actions (heal included) per sliding
+    # serve_autoscale_churn_window_s window
+    serve_autoscale_max_actions: int = 8
+    serve_autoscale_churn_window_s: float = 60.0
     # --- training resilience follow-ups (ROADMAP) ---
     # device-side liveness probe on the step watchdog: a tiny chained
     # collective heartbeat runs on its own thread; if the device stops
@@ -497,6 +534,31 @@ class Config:
         assert (self.serve_resubmit_backoff_max_s
                 >= self.serve_resubmit_backoff_s), (
             self.serve_resubmit_backoff_max_s)
+        assert self.serve_min_replicas >= 1, self.serve_min_replicas
+        assert self.serve_max_replicas >= 0, self.serve_max_replicas
+        if self.serve_max_replicas:
+            assert self.serve_max_replicas >= self.serve_min_replicas, (
+                self.serve_max_replicas)
+        assert self.serve_autoscale_every_ticks >= 1, (
+            self.serve_autoscale_every_ticks)
+        assert self.serve_autoscale_up_queue_frac > 0, (
+            self.serve_autoscale_up_queue_frac)
+        assert 0 < self.serve_autoscale_up_page_frac <= 1, (
+            self.serve_autoscale_up_page_frac)
+        assert self.serve_autoscale_p95_slo_s >= 0, (
+            self.serve_autoscale_p95_slo_s)
+        assert self.serve_autoscale_down_queue_frac >= 0, (
+            self.serve_autoscale_down_queue_frac)
+        assert 0 <= self.serve_autoscale_down_busy_frac <= 1, (
+            self.serve_autoscale_down_busy_frac)
+        assert self.serve_autoscale_hysteresis >= 1, (
+            self.serve_autoscale_hysteresis)
+        assert self.serve_autoscale_cooldown_s >= 0, (
+            self.serve_autoscale_cooldown_s)
+        assert self.serve_autoscale_max_actions >= 1, (
+            self.serve_autoscale_max_actions)
+        assert self.serve_autoscale_churn_window_s > 0, (
+            self.serve_autoscale_churn_window_s)
         assert self.snapshot_every_steps >= 0, self.snapshot_every_steps
         assert self.obs_events >= 0, self.obs_events
         assert self.obs_metrics_every_s > 0, self.obs_metrics_every_s

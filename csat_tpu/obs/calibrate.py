@@ -160,6 +160,10 @@ def _probe_compile() -> float:
         return jnp.sum(jax.nn.softmax(h @ w2) ** 2)
 
     args = (jnp.zeros((16, 64)), jnp.zeros((64, 128)), jnp.zeros((128, 32)))
+    # save/restore the caller's setting: a host that runs with the cache
+    # deliberately disabled (CSAT_TPU_NO_CACHE) must not have it silently
+    # re-enabled by a calibration probe
+    prev = getattr(jax.config, "jax_enable_compilation_cache", None)
     cache_off = False
     try:
         jax.config.update("jax_enable_compilation_cache", False)
@@ -171,8 +175,8 @@ def _probe_compile() -> float:
         jax.jit(jax.grad(f, argnums=(1, 2))).lower(*args).compile()
         return time.perf_counter() - t0
     finally:
-        if cache_off:
-            jax.config.update("jax_enable_compilation_cache", True)
+        if cache_off and prev is not None:
+            jax.config.update("jax_enable_compilation_cache", prev)
 
 
 def run_calibration(*, matmul_n: int = 512, memory_mb: int = 64,

@@ -39,7 +39,22 @@ Fault kinds (compilation targets in parentheses):
                       reap-storm health trip, ``serve_fleet_reap_storm``)
 ``retire_replica``    permanent decode faults on one replica — the fleet
                       retires it (rebuild cap) and resubmits its queue
+``corrupt_warmstart`` flip payload bytes in every warm-start store entry
+                      (fleet-level, latched at apply time): the next spawn
+                      must digest-fail, note ``warmstart_miss`` and come up
+                      through the compile path
+``kill_during_spawn`` arm the fleet's spawn-kill hook: the next ``count``
+                      ``add_replica`` bring-ups die mid-spawn (fleet-level,
+                      latched at apply time)
 ====================  =====================================================
+
+The two fleet-level kinds have no per-tick injector to compile onto — they
+latch state at :meth:`FaultPlan.apply` time (``at`` is ignored) and fire
+when the supervisor next spawns.  Pass the supervisor itself to
+:func:`run_chaos` (``supervisor=``) and it is stepped every loop iteration,
+so healing, scale decisions and their failures land in the same timeline
+as the faults; fleet runs also record ``time_to_recover_s`` (first
+capacity drop below 1.0 → first return to 1.0) and ``replicas_spawned``.
 """
 
 from __future__ import annotations
@@ -57,7 +72,12 @@ from csat_tpu.resilience.retry import DataErrorBudgetExceeded
 __all__ = ["FaultEvent", "FaultPlan", "ChaosReport", "run_chaos"]
 
 KINDS = ("nan_logits", "wedge_slot", "hang", "prefill_fail",
-         "decode_fault", "reap_storm", "retire_replica")
+         "decode_fault", "reap_storm", "retire_replica",
+         "corrupt_warmstart", "kill_during_spawn")
+
+# kinds that act on the FLEET (warm-start store / spawn hook), not on any
+# engine's injector — latched at apply time, no per-tick schedule
+FLEET_KINDS = ("corrupt_warmstart", "kill_during_spawn")
 
 # a retired replica must keep faulting through every rebuild attempt —
 # effectively-infinite horizon (matches the PR 11 sick-replica drills)
@@ -141,21 +161,36 @@ class FaultPlan:
 
             engines = {rep.index: rep.engine for rep in target.replicas
                        if not rep.closed and rep.health == HEALTHY}
+            for e in self.events:
+                # fleet-level kinds latch now: the store is corrupted /
+                # the spawn hook armed, and the fault fires whenever the
+                # supervisor next brings a replica up
+                if e.kind == "kill_during_spawn":
+                    target.arm_spawn_kill(e.count)
+                    target.obs.emit("fault.kill_during_spawn", count=e.count)
+                elif e.kind == "corrupt_warmstart":
+                    n = (target.warmstart.corrupt_entries()
+                         if target.warmstart is not None else 0)
+                    target.obs.emit("fault.corrupt_warmstart", entries=n)
         else:
             bad = [e for e in self.events if e.replica != 0]
             if bad:
                 raise ValueError(
                     f"plan {self.name!r} targets replica "
                     f"{bad[0].replica} but the target is a bare engine")
-            if any(e.kind == "retire_replica" for e in self.events):
+            fleet_only = [e for e in self.events
+                          if e.kind == "retire_replica"
+                          or e.kind in FLEET_KINDS]
+            if fleet_only:
                 raise ValueError(
-                    "retire_replica requires a Fleet target — a bare "
-                    "engine has no healthy replica to absorb the work")
+                    f"{fleet_only[0].kind} requires a Fleet target — a "
+                    "bare engine has no replica lifecycle to fault")
             engines = {0: target}
 
         out: Dict[int, FaultInjector] = {}
         for k, eng in engines.items():
-            evs = [e for e in self.events if e.replica == k]
+            evs = [e for e in self.events
+                   if e.replica == k and e.kind not in FLEET_KINDS]
             if not evs:
                 continue
             t0 = eng.ticks
@@ -222,6 +257,10 @@ class ChaosReport:
     timeline: List[dict]
     trace_json: str = ""
     plan_json: str = ""
+    # elasticity (ISSUE 13): first capacity drop below 1.0 → first return
+    # to 1.0, in the target's clock; -1.0 = never dropped / never recovered
+    time_to_recover_s: float = -1.0
+    replicas_spawned: int = 0
 
     @property
     def clean(self) -> bool:
@@ -242,6 +281,8 @@ class ChaosReport:
                 "checks": self.checks,
                 "capacity_frac": self.capacity_frac,
                 "resubmissions": self.resubmissions,
+                "time_to_recover_s": self.time_to_recover_s,
+                "replicas_spawned": self.replicas_spawned,
                 "trace_spec": self.trace_json, "fault_plan": self.plan_json,
             }}) + "\n")
             for rec in self.timeline:
@@ -281,6 +322,7 @@ def run_chaos(
     monitor: Any = None,
     strict: bool = True,
     tick_budget: int = 0,
+    supervisor: Any = None,
 ) -> ChaosReport:
     """Drive ``target`` (engine or fleet) through ``trace`` with ``plan``'s
     faults firing on schedule, the monitor observing every tick, and a
@@ -288,10 +330,15 @@ def run_chaos(
     :class:`~csat_tpu.resilience.invariants.InvariantViolationError` on
     any violation (a chaos run fails loudly); ``strict=False`` records the
     violations in the report — the bench uses that to mark the ledger
-    record degraded instead of crashing the run."""
+    record degraded instead of crashing the run.  ``supervisor`` (an
+    :class:`~csat_tpu.serve.autoscale.AutoScaler` or anything with a
+    ``step()``) is stepped once per loop iteration, so healing happens
+    under the same trace pressure the faults fire into."""
     cfg = target.cfg
     injectors = plan.apply(target) if plan is not None else {}
     del injectors  # installed on the engines; the report reads the events
+    is_fleet = hasattr(target, "replicas")
+    n_replicas0 = len(target.replicas) if is_fleet else 0
 
     steps = cfg.max_tgt_len - 1
     items = trace.items
@@ -305,6 +352,9 @@ def run_chaos(
     poison_budget_hits = 0
     i = 0
     n_ticks = 0
+    # capacity-recovery clock: first drop below 1.0 → first return to 1.0
+    cap_drop_t: Optional[float] = None
+    recover_s = -1.0
     while i < len(items) or target.occupancy or target.queue_depth:
         rel = target.ticks - t_start
         while i < len(items) and items[i].arrival <= rel:
@@ -322,6 +372,18 @@ def run_chaos(
         n_ticks += 1
         if monitor is not None:
             monitor.observe_tick(target)
+        if is_fleet and cap_drop_t is None and target.capacity_frac < 1.0:
+            # latch the dip before the supervisor can heal it away within
+            # the same iteration — tick() is where faults fire
+            cap_drop_t = target.clock()
+        if supervisor is not None:
+            supervisor.step()
+        if is_fleet:
+            cap = target.capacity_frac
+            if cap < 1.0 and cap_drop_t is None:
+                cap_drop_t = target.clock()
+            elif cap >= 1.0 and cap_drop_t is not None and recover_s < 0:
+                recover_s = target.clock() - cap_drop_t
         if n_ticks > budget:
             raise RuntimeError(
                 f"chaos run exceeded {budget} ticks — target not quiescing "
@@ -366,7 +428,6 @@ def run_chaos(
                              if r is not None},
             expected_ids=list(ids.values()))]
         checks = monitor.checks
-    is_fleet = hasattr(target, "replicas")
     report = ChaosReport(
         trace_name=trace.spec.name,
         plan_name=plan.name if plan is not None else "none",
@@ -383,6 +444,9 @@ def run_chaos(
         timeline=_merged_timeline(target, monitor),
         trace_json=trace.spec.to_json(),
         plan_json=plan.to_json() if plan is not None else "",
+        time_to_recover_s=round(recover_s, 4) if recover_s >= 0 else -1.0,
+        replicas_spawned=(len(target.replicas) - n_replicas0
+                          if is_fleet else 0),
     )
     if strict and monitor is not None:
         monitor.assert_clean(report)

@@ -28,6 +28,16 @@ telemetry an operator would read:
   raising (no silent overrun);
 * **drain_clean** — after the driver drains, occupancy and queue depth
   are zero everywhere;
+* **no_double_serve** — elastic fleets (ISSUE 13): every
+  ``fleet.resubmit`` moved work off a replica that had ALREADY emitted
+  ``fleet.retire`` — a request is never re-routed away from a replica
+  still serving it (two replicas holding one request would be a
+  double-serve; exactly-one-terminal stays intact across a
+  retire→replace cycle because replacement replicas carry fresh
+  recorders and fresh engine-local ids);
+* **capacity_recovers** — opt-in (``expect_recovery=True``): after the
+  drain, ``capacity_frac`` is back at 1.0 — the supervisor actually
+  healed every retirement instead of serving degraded forever;
 * **bit_identity** — optional: healthy-replica outputs during a
   sick-replica drill must match a fault-free reference token-for-token
   (:meth:`InvariantMonitor.check_tokens`, used by the ``:chaos`` bench).
@@ -79,9 +89,13 @@ class InvariantMonitor:
     once the target has drained; read ``violations`` / call
     :meth:`assert_clean`."""
 
-    def __init__(self, cfg, postmortem_dir: str = ""):
+    def __init__(self, cfg, postmortem_dir: str = "",
+                 expect_recovery: bool = False):
         self.cfg = cfg
         self.postmortem_dir = postmortem_dir
+        # autoscaled drills set this: a fleet that ends the run below
+        # capacity_frac 1.0 failed to heal — a violation, not a shrug
+        self.expect_recovery = expect_recovery
         self.obs = EventRecorder(capacity=cfg.obs_events, component="chaos")
         self.violations: List[Violation] = []
         self.checks = 0            # invariant evaluations performed
@@ -200,6 +214,44 @@ class InvariantMonitor:
                         f"request {rid} resubmitted {n}x > "
                         f"serve_max_retries {cap}", id=rid, count=n,
                         bound=cap)
+
+        # no-double-serve across replacement (ISSUE 13): work only ever
+        # moves OFF a replica that retired first — the fleet emits
+        # fleet.retire before scheduling any resubmission, so a resubmit
+        # whose source replica has no earlier retire event means the
+        # request left a replica that was still live
+        if hasattr(target, "replicas"):
+            self.checks += 1
+            retired_at: Dict[Any, float] = {}
+            for ts, name, dur, fields in target.obs.events():
+                if name == "fleet.retire" and fields:
+                    src = fields.get("replica")
+                    if src is not None and src not in retired_at:
+                        retired_at[src] = ts
+            for ts, name, dur, fields in target.obs.events():
+                if name == "fleet.resubmit" and fields:
+                    src = fields.get("from_replica")
+                    t_ret = retired_at.get(src)
+                    if t_ret is None or t_ret > ts:
+                        self._violate(
+                            "no_double_serve",
+                            f"request {fields.get('id')} moved off replica "
+                            f"{src} which had not retired",
+                            id=fields.get("id"), replica=src)
+
+        # capacity healed back to 1.0 (autoscaled drills only)
+        if self.expect_recovery and hasattr(target, "replicas"):
+            self.checks += 1
+            cap = target.capacity_frac
+            if cap < 1.0:
+                self._violate(
+                    "capacity_recovers",
+                    f"capacity_frac {cap:.3f} < 1.0 after drain "
+                    f"({len(target.healthy_replicas)} healthy / target "
+                    f"{target.target_replicas})",
+                    capacity_frac=cap,
+                    healthy=len(target.healthy_replicas),
+                    target=target.target_replicas)
 
         # zero KV-page leaks at quiescence
         for label, eng in engines:

@@ -17,6 +17,7 @@ Entry points: :class:`ServeEngine` (submit/poll/tick/drain),
 ``bench.py``'s ``:serve`` mode.
 """
 
+from csat_tpu.serve.autoscale import AutoScaler  # noqa: F401
 from csat_tpu.serve.engine import (  # noqa: F401
     PagePlan,
     Request,
@@ -62,3 +63,4 @@ from csat_tpu.serve.traffic import (  # noqa: F401
     replay,
     zoo_spec,
 )
+from csat_tpu.serve.warmstart import WarmStartStore, warm_compile  # noqa: F401

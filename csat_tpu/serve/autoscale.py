@@ -1,0 +1,172 @@
+"""Metrics-driven fleet supervisor: heal, scale up, scale down (ISSUE 13).
+
+The fleet gives the control surface (``add_replica`` / ``set_target`` /
+``drain_replica``); this module closes the loop from live observability
+to those levers.  Three concerns, strictly ordered each evaluation:
+
+1. **Heal** — the fleet is below target (a replica was retired by a
+   watchdog trip, rebuild-cap exhaustion, reap storm, or chaos): spawn a
+   replacement immediately.  Healing has no hysteresis and no cooldown —
+   restoring promised capacity is never the thing to dampen — but it DOES
+   consume the churn budget, so a crash-looping bring-up (e.g. chaos
+   ``kill_during_spawn`` armed repeatedly) degrades to a bounded retry
+   cadence instead of a spawn storm.
+2. **Scale up** — any pressure signal over threshold (fleet queue depth
+   per healthy slot, worst healthy replica's KV page occupancy, class-0
+   p95 against an optional SLO) for ``serve_autoscale_hysteresis``
+   consecutive evaluations raises the target by one and spawns.
+3. **Scale down** — BOTH underload signals (queue per slot AND busy-slot
+   fraction) under threshold for the same consecutive-evaluation window
+   drains the highest-index healthy replica; the fleet's tick loop closes
+   it once empty.  Scale-down lowers the target first, so
+   ``capacity_frac`` never dips below 1.0 on a voluntary shrink.
+
+Scale actions (not heals) also respect ``serve_autoscale_cooldown_s``
+between actions, and everything shares the sliding-window churn bound
+(``serve_autoscale_max_actions`` per ``serve_autoscale_churn_window_s``).
+One action per evaluation, full stop: a supervisor that can only move the
+fleet one replica per tick window is legible in the obs timeline and
+cannot oscillate faster than its own signals refresh.
+
+The supervisor reads the fleet and its engines strictly through public
+API (the static boundary scan in ``tests/test_ops.py`` covers this
+module) and emits ``autoscale.heal`` / ``autoscale.up`` /
+``autoscale.down`` events into the fleet's recorder, so chaos timelines
+interleave supervisor decisions with the faults that provoked them.
+"""
+
+from __future__ import annotations
+
+from collections import deque
+from typing import Any, Callable, Deque, List, Optional
+
+from csat_tpu.configs import Config
+
+__all__ = ["AutoScaler"]
+
+
+class AutoScaler:
+    """Drives one :class:`~csat_tpu.serve.fleet.Fleet` from its metrics.
+
+    Call :meth:`step` from the serve loop (every iteration is fine — the
+    evaluation cadence is self-gated on fleet ticks).  Returns the list
+    of actions taken (``"heal" | "up" | "down"``), empty when idle."""
+
+    def __init__(self, fleet: Any, cfg: Optional[Config] = None,
+                 log: Callable[[str], None] = lambda m: None):
+        self.fleet = fleet
+        self.cfg = cfg if cfg is not None else fleet.cfg
+        c = self.cfg
+        self.min_replicas = c.serve_min_replicas
+        # ceiling defaults to the constructed size so `--autoscale` on a
+        # fixed `--replicas N` fleet heals but never silently outgrows it
+        self.max_replicas = c.serve_max_replicas or max(
+            fleet.target_replicas, c.serve_min_replicas)
+        self.log = log
+        self._last_eval_tick = -(10 ** 9)
+        self._last_scale_t = -float("inf")
+        self._over = 0   # consecutive over-pressure evaluations
+        self._under = 0  # consecutive underload evaluations
+        self._actions: Deque[float] = deque()  # action timestamps (churn)
+        self.heals = 0
+        self.ups = 0
+        self.downs = 0
+
+    # ---------------- the control loop ----------------
+
+    def step(self) -> List[str]:
+        f = self.fleet
+        if f.ticks - self._last_eval_tick < self.cfg.serve_autoscale_every_ticks:
+            return []
+        self._last_eval_tick = f.ticks
+        now = f.clock()
+        healthy = f.healthy_replicas
+
+        # 1) heal toward target — before any sizing decision
+        want = min(f.target_replicas, self.max_replicas)
+        if len(healthy) < want:
+            if not self._churn_ok(now):
+                return []
+            self._actions.append(now)
+            rep = f.add_replica()
+            self.heals += 1
+            f.obs.emit("autoscale.heal", ok=int(rep is not None),
+                       healthy=len(f.healthy_replicas), target=want)
+            return ["heal"]
+
+        qfrac, page_occ, p95, busy = self._signals(healthy)
+        c = self.cfg
+        over = (qfrac >= c.serve_autoscale_up_queue_frac
+                or page_occ >= c.serve_autoscale_up_page_frac
+                or (c.serve_autoscale_p95_slo_s > 0
+                    and p95 > c.serve_autoscale_p95_slo_s))
+        under = (qfrac <= c.serve_autoscale_down_queue_frac
+                 and busy <= c.serve_autoscale_down_busy_frac)
+        self._over = self._over + 1 if over else 0
+        self._under = self._under + 1 if under else 0
+
+        # 2) scale up
+        if (self._over >= c.serve_autoscale_hysteresis
+                and len(healthy) < self.max_replicas
+                and self._cooldown_ok(now) and self._churn_ok(now)):
+            self._note_scale(now)
+            f.set_target(f.target_replicas + 1)
+            rep = f.add_replica()
+            self.ups += 1
+            self._over = 0
+            f.obs.emit("autoscale.up", ok=int(rep is not None),
+                       target=f.target_replicas, queue_frac=round(qfrac, 3),
+                       page_occ=round(page_occ, 3), p95_s=round(p95, 4))
+            self.log(f"# autoscale: up → target {f.target_replicas} "
+                     f"(queue/slot {qfrac:.2f}, pages {page_occ:.2f}, "
+                     f"p95 {p95:.3f}s)")
+            return ["up"]
+
+        # 3) scale down (drain-then-remove; the fleet tick closes it)
+        if (self._under >= c.serve_autoscale_hysteresis
+                and len(healthy) > self.min_replicas
+                and f.target_replicas > self.min_replicas
+                and self._cooldown_ok(now) and self._churn_ok(now)):
+            victim = max(healthy, key=lambda r: r.index)
+            self._note_scale(now)
+            f.set_target(f.target_replicas - 1)
+            f.drain_replica(victim.index)
+            self.downs += 1
+            self._under = 0
+            f.obs.emit("autoscale.down", replica=victim.index,
+                       target=f.target_replicas, queue_frac=round(qfrac, 3),
+                       busy_frac=round(busy, 3))
+            self.log(f"# autoscale: down → target {f.target_replicas} "
+                     f"(draining replica {victim.index})")
+            return ["down"]
+        return []
+
+    # ---------------- signals + rate limits ----------------
+
+    def _signals(self, healthy: List[Any]):
+        """(queue per healthy slot, worst page occupancy, class-0 p95,
+        busy-slot fraction) — all from public fleet/engine surfaces."""
+        f = self.fleet
+        slots = sum(r.engine.num_slots for r in healthy) or 1
+        qfrac = f.queue_depth / slots
+        occs = [r.engine.stats.pages_in_use / r.engine.stats.pages_usable
+                for r in healthy if r.engine.stats.pages_usable]
+        page_occ = max(occs) if occs else 0.0
+        p95 = max((r.engine.stats.class_p95(0) for r in healthy),
+                  default=0.0)
+        busy = f.occupancy / slots
+        return qfrac, page_occ, p95, busy
+
+    def _cooldown_ok(self, now: float) -> bool:
+        return (now - self._last_scale_t
+                >= self.cfg.serve_autoscale_cooldown_s)
+
+    def _note_scale(self, now: float) -> None:
+        self._last_scale_t = now
+        self._actions.append(now)
+
+    def _churn_ok(self, now: float) -> bool:
+        win = self.cfg.serve_autoscale_churn_window_s
+        while self._actions and now - self._actions[0] > win:
+            self._actions.popleft()
+        return len(self._actions) < self.cfg.serve_autoscale_max_actions

@@ -54,6 +54,21 @@ def _parser() -> argparse.ArgumentParser:
                         "(serve/fleet.py) — each owns its own KV pool, "
                         "program cache, queue and fault budget; 1 = single "
                         "engine (default: config serve_replicas)")
+    p.add_argument("--autoscale", action="store_true",
+                   help="serve: run the metrics-driven fleet supervisor "
+                        "(serve/autoscale.py) — replaces retired replicas "
+                        "and scales between --min_replicas/--max_replicas "
+                        "on queue depth, KV-page occupancy and class-0 p95")
+    p.add_argument("--min_replicas", type=int, default=0,
+                   help="autoscale floor (default: config serve_min_replicas)")
+    p.add_argument("--max_replicas", type=int, default=-1,
+                   help="autoscale ceiling; 0 = the constructed fleet size "
+                        "(default: config serve_max_replicas)")
+    p.add_argument("--warmstart", action="store_true",
+                   help="AOT warm-start store (serve/warmstart.py): persist "
+                        "jax.export'd serving programs under the "
+                        "compilation-cache root so replacement replicas "
+                        "skip trace+lower on bring-up")
     p.add_argument("--kv_layout", default="",
                    help="paged | rect KV-cache layout (default: config "
                         "serve_kv_layout)")
@@ -151,6 +166,14 @@ def build_engine(args):
         overrides["obs_metrics_every_s"] = args.metrics_every_s
     if getattr(args, "postmortem_dir", ""):
         overrides["obs_postmortem_dir"] = args.postmortem_dir
+    if getattr(args, "autoscale", False):
+        overrides["serve_autoscale"] = True
+    if getattr(args, "min_replicas", 0):
+        overrides["serve_min_replicas"] = args.min_replicas
+    if getattr(args, "max_replicas", -1) >= 0:
+        overrides["serve_max_replicas"] = args.max_replicas
+    if getattr(args, "warmstart", False):
+        overrides["serve_warmstart"] = True
     cfg = get_config(args.config, **overrides)
 
     src_vocab, tgt_vocab = load_vocab(cfg.data_dir)
@@ -165,7 +188,8 @@ def build_engine(args):
         cfg.output_dir, cfg.project_name, cfg.task_name)
     params = restore_params(ckpt)
     log = lambda m: print(m, file=sys.stderr)  # noqa: E731
-    if cfg.serve_replicas > 1:
+    if cfg.serve_replicas > 1 or cfg.serve_autoscale:
+        # the supervisor needs the fleet's replica lifecycle even at n=1
         from csat_tpu.serve.fleet import Fleet
 
         engine = Fleet(model, params, cfg, tgt_vocab=tgt_vocab, log=log)
@@ -367,6 +391,12 @@ def _serve(args) -> None:
 
     engine, cfg, src_vocab, trip_vocab = build_engine(args)
     writer, extra, finalize = _telemetry(engine, cfg, args)
+    scaler = None
+    if cfg.serve_autoscale and _is_fleet(engine):
+        from csat_tpu.serve.autoscale import AutoScaler
+
+        scaler = AutoScaler(engine, cfg,
+                            log=lambda m: print(m, file=sys.stderr))
     import jax
 
     n_chips = jax.device_count()
@@ -450,6 +480,10 @@ def _serve(args) -> None:
                 eof = eof or stdin.eof
             if engine.occupancy or engine.queue_depth:
                 engine.tick()
+            if scaler is not None:
+                # every iteration, not just busy ones — healing a retired
+                # replica must not wait for the next request to arrive
+                scaler.step()
             flush_finished(pending)
             if writer is not None:
                 writer.maybe_write(extra=extra())

@@ -91,6 +91,13 @@ from csat_tpu.serve.prefill import (
 from csat_tpu.serve.prefix import PrefixCache, sample_hash
 from csat_tpu.serve.slots import SlotPool, build_decode_step, init_pool
 from csat_tpu.serve.stats import ServeStats
+from csat_tpu.serve.warmstart import (
+    WarmStartStore,
+    git_rev,
+    params_digest,
+    store_root,
+    warm_compile,
+)
 from csat_tpu.utils import EOS_WORD, PAD
 
 __all__ = ["Request", "RequestStatus", "PagePlan", "ServeEngine"]
@@ -181,7 +188,12 @@ class ServeEngine:
         fault_injector: Any = None,
         watchdog_on_timeout: Optional[Callable[[], None]] = None,
         log: Callable[[str], None] = lambda m: None,
+        warmstart: Optional[WarmStartStore] = None,
     ):
+        # bring-up wall clock (NOT self.clock — drills run virtual clocks):
+        # stamped into stats.cold_start_s once every init-time program is
+        # live, the number the autoscaler's healing latency rides on
+        t_build0 = time.perf_counter()
         self.model = model
         self.params = params
         self.cfg = cfg
@@ -268,13 +280,40 @@ class ServeEngine:
         # headroom the paged pool exists to create
         self._dparams = jax.device_put(params)
 
+        # warm-start executable store (serve/warmstart.py, ISSUE 13): a
+        # caller-shared store (the fleet hands every replica the same one)
+        # or a fresh one when cfg.serve_warmstart asks for it.  The key
+        # fields cover everything that shapes an executable or its baked
+        # constants: the decode program closes over _dparams and prefill
+        # closes over _base_key, so params digest and seed are load-bearing
+        self.warmstart = warmstart if warmstart is not None else (
+            WarmStartStore(store_root(cfg), log=log)
+            if cfg.serve_warmstart else None)
+        self._ws_fields: Dict[str, Any] = {}
+        if self.warmstart is not None and self.warmstart.enabled:
+            devs = jax.devices()
+            self._ws_fields = {
+                "mesh": f"{len(devs)}x{devs[0].platform}",
+                "git": git_rev(),
+                "params": params_digest(params),
+                "layout": cfg.serve_kv_layout,
+                "slots": self.num_slots,
+                "steps": self.steps,
+                "src": cfg.max_src_len,
+                "pages": ((self.geo.num_pages, self.geo.page)
+                          if self.paged else ()),
+                "prefix": int(self._prefix is not None),
+                "key_seed": cfg.seed + int(sample_seed),
+            }
+
         # the ONE decode-step program, AOT-compiled up front (pool donated:
         # slot state advances in place, no per-step copies)
         step_fn = (build_paged_decode_step(model, self.geo) if self.paged
                    else build_decode_step(model))
         step = jax.jit(lambda pool: step_fn(self._dparams, pool),
                        donate_argnums=(0,))
-        self._decode_prog = step.lower(self._pool).compile()
+        self._decode_prog = self._aot_compile("decode", step, (self._pool,),
+                                              (0,))
         self.stats.record_compile("decode", (self.num_slots, self.steps))
         self._prefill_progs: Dict[int, Any] = {}
         # tiny host-side row surgery, shape-stable and jitted once each —
@@ -295,8 +334,9 @@ class ServeEngine:
             # retirement, and a lazy compile there would stall the tick
             # loop while every in-flight deadline clock keeps running
             fn = jax.jit(build_release(), donate_argnums=(0,))
-            self._release_prog = fn.lower(
-                self._pool, np.ones((self.num_slots,), bool)).compile()
+            self._release_prog = self._aot_compile(
+                "release", fn,
+                (self._pool, np.ones((self.num_slots,), bool)), (0,))
             self.stats.record_compile("release", (self.num_slots,))
         else:
             self._release_prog = self._freeze_prog
@@ -307,17 +347,26 @@ class ServeEngine:
             # trip the steady-state zero-recompile tripwire
             fn = jax.jit(build_attach(),
                          donate_argnums=(0,))
-            self._attach_prog = fn.lower(
+            self._attach_prog = self._aot_compile("attach", fn, (
                 self._pool,
                 np.full((self.num_slots,), self.num_slots, np.int32),
                 np.zeros((self.num_slots,), np.int32),
                 np.zeros((self.num_slots, self.geo.sp), np.int32),
                 np.zeros((self.num_slots, self.geo.cp), np.int32),
                 np.ones((self.num_slots, self.geo.mem_len), bool),
-            ).compile()
+            ), (0,))
             self.stats.record_compile("attach", (self.num_slots,))
         self._nan_prog = None  # built lazily, fault drills only
         self._sync_page_stats()
+        # init-time programs are live: stamp bring-up cost + provenance.
+        # (Prefill programs compile lazily per occupied bucket and route
+        # through the same store; their provenance lands in the counters.)
+        self.stats.cold_start_s = round(time.perf_counter() - t_build0, 4)
+        self.obs.emit(
+            "engine.cold_start",
+            cold_start_s=self.stats.cold_start_s,
+            warm=int(self.stats.warmstart_hits),
+            cold=int(self.stats.warmstart_misses))
 
         # tick-liveness watchdog: the serving analogue of the step
         # watchdog — beats once per completed tick while work is in
@@ -346,6 +395,21 @@ class ServeEngine:
             self._watchdog = None
         self._flush_postmortems(force=True)
         return True
+
+    def _aot_compile(self, program: str, jit_fn: Any, args: Sequence[Any],
+                     donate: Sequence[int]) -> Any:
+        """AOT-compile one serving program through the warm-start store
+        (plain ``lower().compile()`` when the store is off) and book the
+        warm/cold provenance.  Store failures degrade, never raise — a
+        replacement replica must come up on a corrupt store."""
+        prog, provenance = warm_compile(
+            self.warmstart, program, jit_fn, tuple(args), tuple(donate),
+            dict(self._ws_fields), obs=self.obs, log=self.log)
+        if provenance == "warm":
+            self.stats.warmstart_hits += 1
+        elif self.warmstart is not None and self.warmstart.enabled:
+            self.stats.warmstart_misses += 1
+        return prog
 
     # ---------------- observability plumbing ----------------
 
@@ -728,7 +792,8 @@ class ServeEngine:
         req.done_t = now
         req.sample = None  # release the (N, N) payload
         if status == RequestStatus.OK:
-            self.stats.record_request(req.submit_t, req.admit_t, now, req.n_tokens)
+            self.stats.record_request(req.submit_t, req.admit_t, now,
+                                      req.n_tokens, priority=req.priority)
             self.obs.emit("req.ok", id=req.id, n_tokens=req.n_tokens)
         else:
             if status in (RequestStatus.REJECTED, RequestStatus.SHED):
@@ -1111,8 +1176,10 @@ class ServeEngine:
                     jax.random.fold_in(self._base_key, ordinal), pool),
                 donate_argnums=(5,))
             t0 = time.perf_counter()
-            prog = fn.lower(self._dparams, batch, ids, limits, ordinal,
-                            self._pool).compile()
+            prog = self._aot_compile(
+                f"prefill_n{spec.n}b{spec.batch_size}", fn,
+                (self._dparams, batch, ids, limits, ordinal, self._pool),
+                (5,))
             self.obs.span_from("compile.prefill", t0, n=spec.n)
             self._prefill_progs[k] = prog
             self.stats.record_compile("prefill", (spec.n, spec.batch_size))
@@ -1171,8 +1238,11 @@ class ServeEngine:
                         jax.random.fold_in(self._base_key, ordinal), pool),
                     donate_argnums=(7,))
                 t0 = time.perf_counter()
-                prog = fn.lower(self._dparams, batch, ids, limits, self_rows,
-                                cross_chain, ordinal, self._pool).compile()
+                prog = self._aot_compile(
+                    f"prefill_n{spec.n}b{spec.batch_size}", fn,
+                    (self._dparams, batch, ids, limits, self_rows,
+                     cross_chain, ordinal, self._pool),
+                    (7,))
                 self.obs.span_from("compile.prefill", t0, n=spec.n)
                 self._prefill_progs[k] = prog
                 self.stats.record_compile("prefill", (spec.n, spec.batch_size))

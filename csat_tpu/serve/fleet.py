@@ -34,6 +34,20 @@ interchangeably.  What the layer adds:
   :meth:`summary` aggregates fleet throughput, capacity fraction and
   MERGED latency quantiles (``obs.metrics.merge_histograms`` — never an
   average of per-replica percentiles).
+* **Elasticity** (ISSUE 13) — the fleet heals and resizes.
+  :meth:`add_replica` stamps out a fresh engine (own pool / queue /
+  registry / postmortem dir) through the shared warm-start store
+  (``serve/warmstart.py``) so a replacement comes up in seconds, and it
+  enters the routing table DRAINING→HEALTHY only once its programs are
+  live; :meth:`set_target` + :meth:`drain_replica` give scale-down the
+  same drain-then-close path retirement uses.  ``capacity_frac`` is
+  measured against the TARGET replica count, so healing a retired
+  replica returns it to 1.0 instead of ratcheting down forever.  Replica
+  indices are monotonic — a replaced replica keeps its index and its
+  forensic record; new replicas get fresh indices (and fresh postmortem
+  dirs), so per-replica scrape labels never alias across a replacement.
+  The metrics-driven supervisor that drives these hooks lives in
+  ``serve/autoscale.py``.
 
 The fleet composes engines strictly through their public API — the
 static boundary scan in ``tests/test_ops.py`` fails the build if this
@@ -56,8 +70,13 @@ from csat_tpu.obs import EventRecorder
 from csat_tpu.obs.metrics import MetricsRegistry, merge_histograms
 from csat_tpu.serve.engine import Request, RequestStatus, ServeEngine
 from csat_tpu.serve.router import DRAINING, HEALTHY, SICK, Router
+from csat_tpu.serve.warmstart import WarmStartStore, store_root
 
 __all__ = ["Fleet", "Replica"]
+
+# numeric health encoding for the per-replica `serve_health_state` gauge
+# (tools/obs_report.py renders it back to the state name)
+_HEALTH_CODE = {HEALTHY: 0, DRAINING: 1, SICK: 2}
 
 
 @dataclasses.dataclass
@@ -139,6 +158,15 @@ class Fleet:
             "requests moved from a retired replica to a healthy one")
         self._m_retired_replicas = self.registry.counter(
             "fleet_replicas_retired_total", "replicas moved to SICK")
+        self._m_spawned = self.registry.counter(
+            "fleet_replicas_spawned_total",
+            "replicas added after construction (healing / scale-up)")
+        self._m_spawn_failed = self.registry.counter(
+            "fleet_spawns_failed_total",
+            "replica spawn attempts that died during bring-up")
+        self._m_target = self.registry.gauge(
+            "fleet_target_replicas",
+            "desired replica count (autoscaler-adjusted)")
         self._m_healthy = self.registry.gauge(
             "fleet_healthy_replicas", "replicas currently in rotation")
         self._m_capacity = self.registry.gauge(
@@ -149,25 +177,28 @@ class Fleet:
             "fleet_slots_occupied", "busy decode slots across live replicas")
         self.registry.gauge("fleet_replicas", "configured replica count").set(n)
 
+        # replica factory inputs, retained for add_replica (healing /
+        # scale-up builds engines long after construction)
+        self._model = model
+        self._params = params
+        self._tgt_vocab = tgt_vocab
+        self._sample_seed = sample_seed
+        # ONE warm-start store shared by every replica (public: the chaos
+        # harness corrupts it through this handle): the first bring-up
+        # pays the cold compile and publishes artifacts; every replacement
+        # deserializes them
+        self.warmstart = (WarmStartStore(store_root(cfg), log=log)
+                          if cfg.serve_warmstart else None)
+        # chaos hook (arm_spawn_kill): the next N spawns die mid-bring-up
+        self._spawn_kills = 0
         self.replicas: List[Replica] = []
         for k in range(n):
-            rep_cfg = cfg
-            if self._postmortem_dir:
-                rep_cfg = cfg.replace(obs_postmortem_dir=os.path.join(
-                    self._postmortem_dir, f"replica{k}"))
-            rep = Replica(index=k, engine=None)
-
-            def on_timeout(rep: Replica = rep) -> None:
-                # replaces the engine watchdog's default os._exit(76): in a
-                # fleet a wedged replica is a capacity event, not a process
-                # event — flag it and let the next tick retire the replica
-                rep.watchdog_tripped = True
-
-            rep.engine = ServeEngine(
-                model, params, rep_cfg, tgt_vocab=tgt_vocab, clock=clock,
-                sample_seed=sample_seed, watchdog_on_timeout=on_timeout,
-                log=(lambda m, k=k: log(f"[replica{k}] {m}")))
+            rep = self._make_replica(k)
+            rep.health = HEALTHY
             self.replicas.append(rep)
+        # desired replica count — capacity_frac's denominator. set_target
+        # moves it; healing closes the gap between it and the healthy count
+        self._target_replicas = n
 
         # fleet id → (replica index, engine-local id); the route is the
         # single source of truth for where a request currently lives
@@ -375,6 +406,85 @@ class Fleet:
             self.obs.emit("fleet.draining", replica=k)
             self._update_gauges()
 
+    # ---------------- elasticity (ISSUE 13) ----------------
+
+    def _make_replica(self, k: int) -> Replica:
+        """Build replica ``k``: fresh engine, own postmortem dir, fleet
+        watchdog override, shared warm-start store.  The replica starts
+        DRAINING (invisible to the router — it is not in ``self.replicas``
+        yet either); the caller promotes it to HEALTHY once the engine
+        ctor has returned, i.e. once its programs are live."""
+        cfg = self.cfg
+        if self._postmortem_dir:
+            cfg = cfg.replace(obs_postmortem_dir=os.path.join(
+                self._postmortem_dir, f"replica{k}"))
+        rep = Replica(index=k, engine=None, health=DRAINING)
+
+        def on_timeout(rep: Replica = rep) -> None:
+            # replaces the engine watchdog's default os._exit(76): in a
+            # fleet a wedged replica is a capacity event, not a process
+            # event — flag it and let the next tick retire the replica
+            rep.watchdog_tripped = True
+
+        rep.engine = ServeEngine(
+            self._model, self._params, cfg, tgt_vocab=self._tgt_vocab,
+            clock=self.clock, sample_seed=self._sample_seed,
+            watchdog_on_timeout=on_timeout, warmstart=self.warmstart,
+            log=(lambda m, k=k: self.log(f"[replica{k}] {m}")))
+        if self._spawn_kills > 0:
+            # chaos kill_during_spawn: the replica dies after bring-up but
+            # before promotion — stop its watchdog thread and fail the
+            # spawn the way any mid-bring-up crash would
+            self._spawn_kills -= 1
+            rep.engine.close()
+            raise RuntimeError("killed during spawn (chaos)")
+        return rep
+
+    def add_replica(self) -> Optional[Replica]:
+        """Heal / scale up: bring up ONE fresh replica (monotonic index,
+        own pool / queue / registry / postmortem dir) and enter it into
+        rotation.  Never raises: a bring-up failure (chaos kill, OOM,
+        corrupt store escalation) is a structured ``fleet.spawn_failed``
+        event + None — the supervisor retries on its own cadence."""
+        k = len(self.replicas)
+        t0 = time.perf_counter()
+        self.obs.emit("fleet.spawn_start", replica=k)
+        try:
+            rep = self._make_replica(k)
+        except Exception as e:  # noqa: BLE001 — spawn failure is a capacity
+            #                     event for the supervisor, never a crash
+            self._m_spawn_failed.inc()
+            self.obs.emit("fleet.spawn_failed", replica=k, error=str(e))
+            self.log(f"# fleet: replica {k} spawn failed ({e})")
+            return None
+        # programs are live (the engine ctor AOT-compiles them): promote
+        rep.health = HEALTHY
+        self.replicas.append(rep)
+        self._m_spawned.inc()
+        s = rep.engine.stats
+        self.obs.emit(
+            "fleet.spawn", replica=k, cold_start_s=s.cold_start_s,
+            warm=int(s.warmstart_hits), cold=int(s.warmstart_misses),
+            spawn_s=round(time.perf_counter() - t0, 4))
+        self.log(
+            f"# fleet: replica {k} spawned in {s.cold_start_s:.2f}s "
+            f"({int(s.warmstart_hits)} warm / {int(s.warmstart_misses)} cold "
+            f"programs); capacity {self.capacity_frac:.2f}")
+        self._update_gauges()
+        return rep
+
+    def set_target(self, n: int) -> None:
+        """Move the desired replica count (the autoscaler's lever and
+        ``capacity_frac``'s denominator). Floor 1 — a fleet with a zero
+        target is a shutdown, which is :meth:`close`'s job."""
+        self._target_replicas = max(1, int(n))
+        self._update_gauges()
+
+    def arm_spawn_kill(self, count: int = 1) -> None:
+        """Chaos hook (``kill_during_spawn`` fault kind): the next
+        ``count`` spawn attempts die during bring-up."""
+        self._spawn_kills += int(count)
+
     def close(self) -> None:
         """Close every replica (idempotent — engine.close guards)."""
         for rep in self.replicas:
@@ -388,7 +498,9 @@ class Fleet:
 
     @property
     def num_slots(self) -> int:
-        return sum(r.engine.num_slots for r in self.replicas)
+        # live (non-closed) replicas: retired and drained-out engines no
+        # longer contribute slots a drive loop could fill
+        return sum(r.engine.num_slots for r in self.replicas if not r.closed)
 
     @property
     def occupancy(self) -> int:
@@ -407,11 +519,16 @@ class Fleet:
         return [r for r in self.replicas if r.health == HEALTHY]
 
     @property
+    def target_replicas(self) -> int:
+        return self._target_replicas
+
+    @property
     def capacity_frac(self) -> float:
-        """Healthy decode slots as a fraction of configured slots — the
-        sick-replica drill's headline: one of N equal replicas down
-        reads (N-1)/N."""
-        total = sum(r.engine.num_slots for r in self.replicas)
+        """Healthy decode slots as a fraction of the TARGET capacity —
+        one of N equal replicas down reads (N-1)/N, and healing it reads
+        1.0 again (the denominator is what the fleet should be running,
+        not the monotonic count of every replica that ever existed)."""
+        total = self._target_replicas * self.cfg.serve_slots
         healthy = sum(r.engine.num_slots for r in self.healthy_replicas)
         return healthy / total if total else 0.0
 
@@ -467,7 +584,8 @@ class Fleet:
         for rep in self.replicas:
             s = rep.engine.stats.summary(wall_s=wall_s, n_chips=n_chips)
             per.append({"replica": rep.index, "health": rep.health,
-                        "sick_reason": rep.sick_reason, **s})
+                        "sick_reason": rep.sick_reason,
+                        "cold_start_s": rep.engine.stats.cold_start_s, **s})
 
         def total(key: str) -> float:
             return sum(p[key] for p in per)
@@ -482,6 +600,8 @@ class Fleet:
         return {
             "replicas": len(self.replicas),
             "healthy_replicas": len(self.healthy_replicas),
+            "target_replicas": self._target_replicas,
+            "replicas_spawned": int(self._m_spawned.value),
             "capacity_frac": round(self.capacity_frac, 4),
             "num_slots": self.num_slots,
             # fleet ids issued; per-replica `submitted` double-counts moved
@@ -645,3 +765,11 @@ class Fleet:
         self._m_capacity.set(round(self.capacity_frac, 4))
         self._m_queue.set(self.queue_depth)
         self._m_occupancy.set(self.occupancy)
+        self._m_target.set(self._target_replicas)
+        for rep in self.replicas:
+            # per-replica health on the replica's own scrape surface
+            # (registry.gauge is get-or-create, so this is idempotent)
+            rep.engine.stats.registry.gauge(
+                "serve_health_state",
+                "replica health: 0=HEALTHY 1=DRAINING 2=SICK",
+            ).set(_HEALTH_CODE[rep.health])

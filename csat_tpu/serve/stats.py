@@ -119,6 +119,13 @@ _METRICS = {
                     "queued (not yet admitted) requests"),
     "occupancy": ("gauge", "serve_slots_occupied",
                   "decode slots currently in flight"),
+    # warm-start executable store (serve/warmstart.py, ISSUE 13)
+    "warmstart_hits": ("counter", "serve_warmstart_hits_total",
+                       "programs deserialized from the warm-start store"),
+    "warmstart_misses": ("counter", "serve_warmstart_misses_total",
+                         "store-enabled compiles that went cold (any reason)"),
+    "cold_start_s": ("gauge", "serve_cold_start_s",
+                     "engine bring-up wall time (ctor to programs live)"),
 }
 
 
@@ -151,6 +158,12 @@ class ServeStats:
     pages_in_use = _Backed()    # last per-tick occupancy sample
     queue_depth = _Backed()     # scrape-surface mirrors (engine-stamped)
     occupancy = _Backed()
+    # warm-start provenance (serve/warmstart.py): hits deserialize a stored
+    # executable, misses fell through to a fresh compile; cold_start_s is
+    # the bring-up wall time the autoscaler's healing latency rides on
+    warmstart_hits = _Backed()
+    warmstart_misses = _Backed()
+    cold_start_s = _Backed()
 
     def __init__(self, num_slots: int,
                  registry: Optional[MetricsRegistry] = None):
@@ -177,6 +190,10 @@ class ServeStats:
         self._page_samples = 0
         self.wait_s: Deque[float] = deque(maxlen=LATENCY_WINDOW)     # submit → admit
         self.latency_s: Deque[float] = deque(maxlen=LATENCY_WINDOW)  # submit → done
+        # per-priority-class latency windows: the autoscaler's p95 signal
+        # reads class 0 (gold) so brownout-capped low tiers cannot mask an
+        # SLO breach on the tier that matters
+        self.latency_by_class: Dict[int, Deque[float]] = {}
         self.first_done_t: Optional[float] = None
         self.last_done_t: Optional[float] = None
         self.started_t: Optional[float] = None
@@ -210,7 +227,7 @@ class ServeStats:
         self._page_samples += 1
 
     def record_request(self, submit_t: float, admit_t: float, done_t: float,
-                       n_tokens: int) -> None:
+                       n_tokens: int, priority: int = 0) -> None:
         self.retired += 1
         self.gen_tokens += int(n_tokens)
         wait = admit_t - submit_t
@@ -219,9 +236,16 @@ class ServeStats:
         self.latency_s.append(latency)
         self.wait_hist.observe(wait)
         self.latency_hist.observe(latency)
+        cls = self.latency_by_class.setdefault(
+            int(priority), deque(maxlen=LATENCY_WINDOW))
+        cls.append(latency)
         if self.first_done_t is None:
             self.first_done_t = done_t
         self.last_done_t = done_t
+
+    def class_p95(self, priority: int = 0) -> float:
+        """OK-latency p95 for one priority class (0.0 with no samples)."""
+        return percentile(self.latency_by_class.get(int(priority), ()), 95)
 
     def record_outcome(self, status: str) -> None:
         """Count one non-OK terminal outcome (``RequestStatus`` value) —

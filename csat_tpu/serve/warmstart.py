@@ -1,0 +1,320 @@
+"""Warm-start executable store: AOT-serialized serving programs (ISSUE 13).
+
+A fresh engine pays the full trace+lower+compile tax for every serving
+program — the ROADMAP's "second-scale cold start" item.  The persistent
+XLA compilation cache (``utils/cache.py``) already removes the *backend
+compile* on a warm box, but tracing and lowering the model still dominate
+replica bring-up on the bench host.  This store removes that too: each
+compiled serving program is exported once (``jax.export`` → StableHLO
+bytes) and persisted next to the compilation cache; a later engine
+deserializes the artifact and goes straight to backend compile — which
+then hits the warm ``.jax_cache``.
+
+Layering and keying:
+
+* the store lives UNDER the compilation-cache root
+  (``<cache root>/warmstart`` by default, ``serve_warmstart_dir`` to
+  relocate) and honors the same kill switch: ``CSAT_TPU_NO_CACHE``
+  disables both layers — every load is a structured miss
+  (``reason="disabled"``), every save a no-op;
+* entries are keyed by a digest over (program name, shape bucket, mesh,
+  dtype, kv layout, git rev, jaxlib version, params digest) — anything
+  that could change the compiled program or its baked-in constants.  The
+  decode program closes over the device params (engine.py's dispatch
+  optimization), so the params digest is load-bearing: a warm artifact
+  with stale weights must never match;
+* every entry is digest-verified at load (header records the payload
+  sha256).  A corrupt, truncated, stale or version-mismatched entry is a
+  structured ``warmstart_miss{reason}`` note and a fresh compile — NEVER
+  a crash: the store is an optimization, not a dependency.
+
+Bit-identity: :func:`warm_compile` routes the COLD path through the same
+``export → deserialize-free → compile`` pipeline the warm path uses, so a
+warm-started replica and a cold-started one run byte-identical StableHLO
+— the fleet's healthy-replica bit-identity invariant holds across a
+retire → replace cycle by construction (verified in
+``tests/test_autoscale.py``).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import subprocess
+import tempfile
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+from csat_tpu.utils.cache import DEFAULT_DIR
+
+__all__ = ["WarmStartStore", "warm_compile", "store_root", "git_rev",
+           "params_digest"]
+
+_MAGIC = "csat-warmstart-v1"
+
+_git_rev_cache: Optional[str] = None
+_serialization_registered = False
+
+
+def _register_pytree_serialization() -> None:
+    """``jax.export`` refuses to serialize unregistered custom pytree
+    nodes; the serving pools are NamedTuples in every program signature.
+    Idempotent and tolerant of double registration (e.g. across reloads)."""
+    global _serialization_registered
+    if _serialization_registered:
+        return
+    from jax import export as jax_export
+
+    from csat_tpu.data.dataset import Batch
+    from csat_tpu.serve.pages import PagedPool
+    from csat_tpu.serve.slots import SlotPool
+
+    # pools ride every program signature; Batch rides the prefill's
+    for t in (PagedPool, SlotPool, Batch):
+        try:
+            jax_export.register_namedtuple_serialization(
+                t, serialized_name=f"{t.__module__}.{t.__name__}")
+        except ValueError:
+            pass
+    _serialization_registered = True
+
+
+def git_rev() -> str:
+    """The repo's HEAD commit (cached; ``"unknown"`` outside a checkout).
+    Part of every store key: a code change invalidates warm artifacts."""
+    global _git_rev_cache
+    if _git_rev_cache is None:
+        try:
+            _git_rev_cache = subprocess.run(
+                ["git", "rev-parse", "HEAD"],
+                cwd=os.path.dirname(os.path.abspath(__file__)),
+                capture_output=True, text=True, timeout=5,
+            ).stdout.strip() or "unknown"
+        except Exception:  # noqa: BLE001 — key component, never a crash
+            _git_rev_cache = "unknown"
+    return _git_rev_cache
+
+
+def params_digest(params: Any) -> str:
+    """sha256 over every param leaf's bytes (structure included via the
+    leaf order).  Load-bearing for the decode program, which bakes the
+    params in as executable constants — an artifact built from different
+    weights must never key-match.  O(model size) host work, paid once per
+    engine bring-up and only when the store is enabled."""
+    import jax
+    import numpy as np
+
+    h = hashlib.sha256()
+    for leaf in jax.tree.leaves(params):
+        a = np.asarray(leaf)
+        h.update(str((a.shape, str(a.dtype))).encode())
+        h.update(a.tobytes())
+    return h.hexdigest()[:32]
+
+
+def store_root(cfg: Any = None) -> Optional[str]:
+    """Resolve the store directory from config + environment.
+
+    ``CSAT_TPU_NO_CACHE`` wins (→ None, store disabled) — one knob turns
+    off every persistent-compilation layer.  Otherwise an explicit
+    ``serve_warmstart_dir`` is used verbatim; else the store nests under
+    the compilation-cache root (``CSAT_TPU_CACHE_DIR`` or the repo-local
+    default), so relocating the cache relocates the warm artifacts too."""
+    if os.environ.get("CSAT_TPU_NO_CACHE", "0") not in ("", "0"):
+        return None
+    explicit = getattr(cfg, "serve_warmstart_dir", "") if cfg is not None else ""
+    if explicit:
+        return explicit
+    base = os.environ.get("CSAT_TPU_CACHE_DIR") or DEFAULT_DIR
+    return os.path.join(base, "warmstart")
+
+
+class WarmStartStore:
+    """Digest-verified file store of serialized serving executables.
+
+    One file per entry: a JSON header line (magic, key fields, payload
+    sha256, jaxlib version) followed by the ``jax.export`` payload bytes.
+    Every failure mode — absent, unreadable, corrupt header, payload
+    digest mismatch, version skew — comes back as ``(None, reason)``;
+    :meth:`load` and :meth:`save` never raise."""
+
+    def __init__(self, root: Optional[str],
+                 log: Callable[[str], None] = lambda m: None):
+        self.root = root
+        self.log = log
+        if root is not None:
+            try:
+                os.makedirs(root, exist_ok=True)
+            except OSError as e:
+                # an unwritable store must not turn warm start into a
+                # bring-up failure — run with the store off
+                log(f"# warmstart store disabled ({root}: {e})")
+                self.root = None
+
+    @property
+    def enabled(self) -> bool:
+        return self.root is not None
+
+    # ---------------- keying ----------------
+
+    @staticmethod
+    def key(program: str, fields: Dict[str, Any]) -> str:
+        import jaxlib
+
+        material = json.dumps(
+            {"program": program, "jaxlib": jaxlib.__version__, **fields},
+            sort_keys=True, default=str)
+        return hashlib.sha256(material.encode()).hexdigest()[:40]
+
+    def path(self, program: str, fields: Dict[str, Any]) -> Optional[str]:
+        if self.root is None:
+            return None
+        return os.path.join(self.root, f"{program}-{self.key(program, fields)}.ws")
+
+    # ---------------- load / save ----------------
+
+    def load(self, program: str,
+             fields: Dict[str, Any]) -> Tuple[Optional[bytes], str]:
+        """→ ``(payload, "hit")`` or ``(None, miss reason)``.  The miss
+        reason is one of ``disabled | absent | corrupt_header |
+        digest_mismatch | jaxlib_mismatch | io_error`` — the structured
+        ``warmstart_miss{reason}`` vocabulary."""
+        import jaxlib
+
+        if self.root is None:
+            return None, "disabled"
+        path = self.path(program, fields)
+        if not os.path.exists(path):
+            return None, "absent"
+        try:
+            with open(path, "rb") as f:
+                header_line = f.readline()
+                payload = f.read()
+        except OSError:
+            return None, "io_error"
+        try:
+            header = json.loads(header_line)
+            assert header["magic"] == _MAGIC
+            want = header["payload_sha256"]
+        except Exception:  # noqa: BLE001 — any malformed header is a miss
+            return None, "corrupt_header"
+        if header.get("jaxlib") != jaxlib.__version__:
+            # belt and braces: the key already includes the jaxlib version,
+            # but a hand-copied or renamed entry must still be refused
+            return None, "jaxlib_mismatch"
+        if hashlib.sha256(payload).hexdigest() != want:
+            return None, "digest_mismatch"
+        return payload, "hit"
+
+    def save(self, program: str, fields: Dict[str, Any],
+             payload: bytes) -> bool:
+        """Atomic write (tmp + rename): a concurrent spawn reading the
+        entry sees either the old complete file or the new one, never a
+        torn write.  Returns False (never raises) on any failure."""
+        import jaxlib
+
+        path = self.path(program, fields)
+        if path is None:
+            return False
+        header = json.dumps({
+            "magic": _MAGIC, "program": program,
+            "jaxlib": jaxlib.__version__,
+            "payload_sha256": hashlib.sha256(payload).hexdigest(),
+            "fields": {k: str(v) for k, v in sorted(fields.items())},
+        }).encode()
+        try:
+            fd, tmp = tempfile.mkstemp(dir=self.root, suffix=".tmp")
+            with os.fdopen(fd, "wb") as f:
+                f.write(header + b"\n" + payload)
+            os.replace(tmp, path)
+            return True
+        except OSError as e:
+            self.log(f"# warmstart save failed ({program}: {e})")
+            return False
+
+    # ---------------- introspection / chaos hooks ----------------
+
+    def entries(self) -> List[str]:
+        """Entry file paths, sorted (empty when disabled)."""
+        if self.root is None:
+            return []
+        try:
+            return sorted(
+                os.path.join(self.root, n) for n in os.listdir(self.root)
+                if n.endswith(".ws"))
+        except OSError:
+            return []
+
+    def corrupt_entries(self) -> int:
+        """Chaos hook (``corrupt_warmstart`` fault kind): flip payload
+        bytes in every entry, keeping the header intact — the next load
+        fails its digest check and falls back to a fresh compile.  Returns
+        the number of entries corrupted."""
+        n = 0
+        for path in self.entries():
+            try:
+                with open(path, "r+b") as f:
+                    f.readline()  # keep the header
+                    pos = f.tell()
+                    f.seek(pos)
+                    f.write(b"\xde\xad\xbe\xef")
+                n += 1
+            except OSError:
+                continue
+        return n
+
+
+def warm_compile(
+    store: Optional[WarmStartStore],
+    program: str,
+    jit_fn: Any,
+    args: Tuple[Any, ...],
+    donate_argnums: Tuple[int, ...],
+    key_fields: Dict[str, Any],
+    obs: Any = None,
+    log: Callable[[str], None] = lambda m: None,
+) -> Tuple[Any, str]:
+    """AOT-compile one serving program through the warm-start store.
+
+    → ``(compiled, provenance)`` with provenance ``"warm"`` (deserialized
+    from the store), ``"cold"`` (freshly exported, artifact saved) or
+    ``"off"`` (store absent/disabled, or ``jax.export`` unavailable for
+    this program — plain ``lower().compile()``).  Warm and cold both
+    compile the exported StableHLO, so their executables are identical by
+    construction; every store failure emits a ``warmstart_miss{reason}``
+    note on ``obs`` and degrades to a colder path, never an exception."""
+    import jax
+
+    donate = tuple(donate_argnums)
+    if store is not None and store.enabled:
+        from jax import export as jax_export
+
+        _register_pytree_serialization()
+
+        payload, reason = store.load(program, key_fields)
+        if payload is not None:
+            try:
+                exported = jax_export.deserialize(bytearray(payload))
+                prog = jax.jit(exported.call, donate_argnums=donate).lower(
+                    *args).compile()
+                if obs is not None:
+                    obs.emit("warmstart.hit", program=program)
+                return prog, "warm"
+            except Exception as e:  # noqa: BLE001 — artifact rot is a miss
+                reason = f"deserialize_failed:{type(e).__name__}"
+        if obs is not None:
+            obs.emit("warmstart_miss", program=program, reason=reason)
+        log(f"# warmstart_miss{{program={program!r}, reason={reason!r}}}")
+        try:
+            exported = jax_export.export(jit_fn)(*args)
+            prog = jax.jit(exported.call, donate_argnums=donate).lower(
+                *args).compile()
+            store.save(program, key_fields, exported.serialize())
+            return prog, "cold"
+        except Exception as e:  # noqa: BLE001 — export is best-effort
+            if obs is not None:
+                obs.emit("warmstart_miss", program=program,
+                         reason=f"export_failed:{type(e).__name__}")
+            log(f"# warmstart export failed ({program}: "
+                f"{type(e).__name__}: {e}) — compiling directly")
+    return jit_fn.lower(*args).compile(), "off"

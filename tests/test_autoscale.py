@@ -1,0 +1,443 @@
+"""Self-healing elastic fleet (ISSUE 13 tentpole pieces 2+3).
+
+Pins the elastic-fleet contracts:
+
+* **lifecycle** — ``Fleet.add_replica`` brings a fresh replica up at a
+  monotonic index (DRAINING until live, HEALTHY after), ``set_target`` +
+  ``drain_replica`` shrink cleanly with ``capacity_frac`` back at 1.0,
+  and a spawn killed mid-bring-up (chaos ``kill_during_spawn``) is a
+  structured ``fleet.spawn_failed`` — never an exception, never a
+  half-built replica in the fleet;
+* **supervisor** — :class:`AutoScaler` heals below-target fleets without
+  cooldown, scales up/down on the metric signals behind hysteresis +
+  cooldown + a sliding churn bound (exact control-flow pinned on a fake
+  fleet, no compiles), one action per evaluation;
+* **chaos-proven recovery** — the bursty-trace drill with a mid-burst
+  retirement and the supervisor attached runs STRICT (zero violations,
+  ``capacity_recovers`` and ``no_double_serve`` included), records
+  ``time_to_recover_s``, and the replacement warm-starts from the store
+  with bit-identity to a solo engine preserved across retire → replace;
+* **isolation** — the replacement owns a cold prefix cache, its own
+  stats/pool accounting, and fresh per-replica hit-rate counters.
+"""
+
+import numpy as np
+import pytest
+
+from csat_tpu.configs import get_config
+from csat_tpu.data.toy import random_request_sample
+from csat_tpu.resilience import (
+    FaultEvent,
+    FaultPlan,
+    InvariantMonitor,
+    run_chaos,
+)
+from csat_tpu.serve import AutoScaler, Fleet, ServeEngine, collate_requests
+from csat_tpu.serve.router import DRAINING, HEALTHY, SICK
+from csat_tpu.serve.traffic import make_trace, zoo_spec
+
+SRC_V, TGT_V, TRIP_V = 200, 300, 50
+
+
+@pytest.fixture(scope="module")
+def auto_cfg(micro_config):
+    """Deterministic micro config on the bit-identity paths: 2 slots, one
+    prefill bucket, fast heal cadence, retries enabled for resubmission."""
+    return micro_config.replace(
+        full_att=True, dropout=0.0, attention_dropout=0.0,
+        cse_empty_rows="zero", serve_slots=2, bucket_src_lens=(48,),
+        serve_max_rebuilds=0, serve_resubmit_backoff_s=0.0,
+        serve_autoscale_every_ticks=1,
+    )
+
+
+@pytest.fixture(scope="module")
+def stack(auto_cfg):
+    from csat_tpu.train.state import create_train_state, default_optimizer, make_model
+
+    cfg = auto_cfg
+    model = make_model(cfg, SRC_V, TGT_V, TRIP_V)
+    warm = collate_requests(
+        [random_request_sample(cfg, SRC_V, TRIP_V, 8, seed=0)],
+        cfg.max_src_len, 1, cfg, tgt_width=cfg.max_tgt_len - 1)
+    params = create_train_state(
+        model, default_optimizer(cfg), warm, seed=0).params
+    return cfg, model, params
+
+
+def _samples(cfg, n=4, seed=3):
+    rng = np.random.default_rng(seed)
+    return [random_request_sample(cfg, SRC_V, TRIP_V, int(ln), seed=200 + i)
+            for i, ln in enumerate(rng.integers(5, cfg.max_src_len, n))]
+
+
+def _tokens(reqs):
+    return [np.asarray(r.tokens)[: r.n_tokens].tolist() for r in reqs]
+
+
+def _event_names(obs):
+    return [name for _, name, _, _ in obs.events()]
+
+
+# ---------------------------------------------------------------------------
+# fleet lifecycle: add_replica / set_target / drain / spawn kill
+# ---------------------------------------------------------------------------
+
+
+def test_add_replica_then_drain_restores_capacity(stack):
+    cfg, model, params = stack
+    fleet = Fleet(model, params, cfg, replicas=1, sample_seed=0)
+    assert fleet.capacity_frac == 1.0 and fleet.target_replicas == 1
+
+    fleet.set_target(2)
+    assert fleet.capacity_frac == 0.5  # promised capacity, not built yet
+    rep = fleet.add_replica()
+    assert rep is not None and rep.index == 1 and rep.health == HEALTHY
+    assert fleet.capacity_frac == 1.0
+    assert fleet.num_slots == 2 * cfg.serve_slots
+    names = _event_names(fleet.obs)
+    assert "fleet.spawn_start" in names and "fleet.spawn" in names
+    spawn = next(f for _, n, _, f in fleet.obs.events() if n == "fleet.spawn")
+    assert spawn["replica"] == 1 and spawn["cold_start_s"] > 0
+    summ = fleet.summary()
+    assert summ["replicas_spawned"] == 1 and summ["target_replicas"] == 2
+    assert all("cold_start_s" in r for r in summ["per_replica"])
+
+    # both replicas actually serve
+    reqs = fleet.generate(_samples(cfg))
+    assert all(r.ok for r in reqs)
+
+    # voluntary shrink: target drops FIRST, so capacity never dips < 1.0
+    fleet.set_target(1)
+    fleet.drain_replica(1)
+    assert fleet.replicas[1].health == DRAINING
+    for _ in range(6):
+        fleet.tick()
+        if fleet.replicas[1].closed:
+            break
+    assert fleet.replicas[1].closed
+    assert fleet.capacity_frac == 1.0
+    assert fleet.num_slots == cfg.serve_slots  # closed replicas don't count
+    fleet.close()
+
+
+def test_killed_spawn_is_structured_failure_then_retry_succeeds(stack):
+    cfg, model, params = stack
+    fleet = Fleet(model, params, cfg, replicas=1, sample_seed=0)
+    fleet.set_target(2)
+    fleet.arm_spawn_kill(1)
+    assert fleet.add_replica() is None  # never an exception out of here
+    assert len(fleet.replicas) == 1  # no half-built replica appended
+    assert "fleet.spawn_failed" in _event_names(fleet.obs)
+    assert fleet.capacity_frac == 0.5
+    rep = fleet.add_replica()  # the kill latch is spent: retry succeeds
+    assert rep is not None and rep.health == HEALTHY
+    assert fleet.capacity_frac == 1.0
+    fleet.close()
+
+
+def test_fleet_fault_kinds_rejected_on_bare_engine(stack):
+    cfg, model, params = stack
+    eng = ServeEngine(model, params, cfg, sample_seed=0)
+    for kind in ("corrupt_warmstart", "kill_during_spawn"):
+        with pytest.raises(ValueError, match="Fleet target"):
+            FaultPlan((FaultEvent(kind, at=1),)).apply(eng)
+    eng.close()
+
+
+# ---------------------------------------------------------------------------
+# supervisor control flow, pinned on a fake fleet (no compiles)
+# ---------------------------------------------------------------------------
+
+
+class _FakeObs:
+    def __init__(self):
+        self.events = []
+
+    def emit(self, name, **fields):
+        self.events.append((name, fields))
+
+
+class _FakeReplica:
+    def __init__(self, index, slots=2):
+        import types
+
+        self.index = index
+        self.engine = types.SimpleNamespace(
+            num_slots=slots,
+            stats=types.SimpleNamespace(
+                pages_in_use=0, pages_usable=10,
+                class_p95=lambda priority=0: 0.0))
+
+
+class _FakeFleet:
+    """The exact public surface AutoScaler reads and drives."""
+
+    def __init__(self, cfg, n=1):
+        self.cfg = cfg
+        self.ticks = 0
+        self.now = 0.0
+        self.queue_depth = 0
+        self.occupancy = 0
+        self.target_replicas = n
+        self.healthy_replicas = [_FakeReplica(k) for k in range(n)]
+        self._next = n
+        self.obs = _FakeObs()
+        self.spawn_ok = True
+
+    def clock(self):
+        return self.now
+
+    def set_target(self, n):
+        self.target_replicas = max(1, int(n))
+
+    def add_replica(self):
+        if not self.spawn_ok:
+            return None
+        rep = _FakeReplica(self._next)
+        self._next += 1
+        self.healthy_replicas.append(rep)
+        return rep
+
+    def drain_replica(self, k):
+        self.healthy_replicas = [
+            r for r in self.healthy_replicas if r.index != k]
+
+
+def _scaler_cfg(**kw):
+    return get_config(
+        "python", serve_slots=2, serve_min_replicas=1, serve_max_replicas=3,
+        serve_autoscale=True, serve_autoscale_every_ticks=1,
+        serve_autoscale_hysteresis=2, serve_autoscale_cooldown_s=10.0,
+        serve_autoscale_up_queue_frac=1.5, serve_autoscale_down_queue_frac=0.1,
+        serve_autoscale_down_busy_frac=0.25, serve_autoscale_max_actions=4,
+        serve_autoscale_churn_window_s=60.0, **kw)
+
+
+def test_scaler_heals_below_target_without_cooldown():
+    fleet = _FakeFleet(_scaler_cfg(), n=2)
+    sc = AutoScaler(fleet)
+    fleet.healthy_replicas.pop()  # a retirement
+    fleet.ticks = 1
+    assert sc.step() == ["heal"]
+    assert sc.heals == 1 and len(fleet.healthy_replicas) == 2
+    # healing again right away is fine (no cooldown) — but only when
+    # below target, and the eval gate requires a fresh tick
+    assert sc.step() == []  # same tick: self-gated
+    fleet.ticks = 2
+    assert sc.step() == []  # at target: nothing to heal
+
+
+def test_scaler_up_needs_hysteresis_and_respects_cooldown_and_ceiling():
+    fleet = _FakeFleet(_scaler_cfg(), n=1)
+    sc = AutoScaler(fleet)
+    fleet.queue_depth = 10  # 5 per slot >> 1.5 threshold
+    fleet.ticks, fleet.now = 1, 1.0
+    assert sc.step() == []  # 1st over-pressure eval: hysteresis holds
+    fleet.ticks, fleet.now = 2, 2.0
+    assert sc.step() == ["up"]
+    assert fleet.target_replicas == 2 and len(fleet.healthy_replicas) == 2
+    assert sc.ups == 1
+    # still overloaded, hysteresis satisfied again — but cooldown blocks
+    fleet.ticks, fleet.now = 3, 3.0
+    assert sc.step() == []
+    fleet.ticks, fleet.now = 4, 4.0
+    assert sc.step() == []
+    fleet.ticks, fleet.now = 5, 13.0  # cooldown elapsed
+    assert sc.step() == ["up"]
+    assert fleet.target_replicas == 3
+    # at the ceiling: no further ups no matter the pressure (two evals
+    # re-satisfy hysteresis with cooldown long elapsed)
+    fleet.ticks, fleet.now = 6, 30.0
+    assert sc.step() == []
+    fleet.ticks, fleet.now = 7, 31.0
+    assert sc.step() == []
+    assert len(fleet.healthy_replicas) == 3 and fleet.target_replicas == 3
+
+
+def test_scaler_down_drains_highest_index_and_lowers_target_first():
+    fleet = _FakeFleet(_scaler_cfg(), n=3)
+    fleet.target_replicas = 3
+    sc = AutoScaler(fleet)
+    fleet.queue_depth = 0
+    fleet.occupancy = 0
+    fleet.ticks, fleet.now = 1, 20.0
+    assert sc.step() == []  # hysteresis
+    fleet.ticks, fleet.now = 2, 21.0
+    assert sc.step() == ["down"]
+    assert fleet.target_replicas == 2
+    assert [r.index for r in fleet.healthy_replicas] == [0, 1]
+    evts = dict(fleet.obs.events)
+    assert evts["autoscale.down"]["replica"] == 2
+    # hysteresis re-arms after the action, then the floor holds
+    fleet.ticks, fleet.now = 3, 100.0
+    assert sc.step() == []  # 1st underload eval since the down
+    fleet.ticks, fleet.now = 4, 101.0
+    assert sc.step() == ["down"] and fleet.target_replicas == 1
+    fleet.ticks, fleet.now = 5, 200.0
+    assert sc.step() == []
+    fleet.ticks, fleet.now = 6, 201.0
+    assert sc.step() == []  # min_replicas floor
+    assert [r.index for r in fleet.healthy_replicas] == [0]
+
+
+def test_scaler_churn_bound_caps_a_heal_storm():
+    fleet = _FakeFleet(_scaler_cfg(), n=2)
+    sc = AutoScaler(fleet)
+    fleet.healthy_replicas.pop()  # a retirement opens the heal gap...
+    fleet.spawn_ok = False  # ...and every spawn attempt fails (crash loop)
+    healed = 0
+    for t in range(1, 10):
+        fleet.ticks, fleet.now = t, float(t)
+        healed += sc.step() == ["heal"]
+    # bounded retry cadence, not a spawn storm: the sliding churn window
+    # (max_actions=4 per 60s) stops the loop
+    assert healed == 4
+    evts = [f for n, f in fleet.obs.events if n == "autoscale.heal"]
+    assert len(evts) == 4 and all(e["ok"] == 0 for e in evts)
+
+
+# ---------------------------------------------------------------------------
+# chaos-proven recovery: retire mid-burst, heal, warm-start, bit identity
+# ---------------------------------------------------------------------------
+
+
+def _ws_fleet(stack, root, **cfg_kw):
+    cfg0, model, params = stack
+    cfg = cfg0.replace(serve_warmstart=True, serve_warmstart_dir=root,
+                       serve_min_replicas=2, serve_max_replicas=2,
+                       serve_autoscale=True, **cfg_kw)
+    return cfg, Fleet(model, params, cfg, replicas=2, sample_seed=0)
+
+
+def test_heal_drill_strict_with_warmstart_and_bit_identity(stack, tmp_path):
+    cfg0, model, params = stack
+    cfg, fleet = _ws_fleet(stack, str(tmp_path / "ws"))
+    # replica 0 seeded the empty store; replica 1 warm-started from it
+    assert int(fleet.replicas[1].engine.stats.warmstart_hits) > 0
+
+    trace = make_trace(
+        zoo_spec("bursty_multitenant", n_requests=6, seed=5,
+                 mean_interarrival=1.0), cfg, SRC_V, TRIP_V)
+    plan = FaultPlan((FaultEvent("retire_replica", at=4, replica=1),),
+                     name="heal_drill")
+    mon = InvariantMonitor(cfg, expect_recovery=True)
+    scaler = AutoScaler(fleet)
+    report = run_chaos(fleet, trace, plan=plan, monitor=mon, strict=True,
+                       supervisor=scaler)  # strict: violations raise
+
+    assert report.violations == []
+    assert scaler.heals == 1 and report.replicas_spawned == 1
+    assert report.time_to_recover_s >= 0
+    assert fleet.capacity_frac == 1.0
+    names = _event_names(fleet.obs)
+    assert "fleet.retire" in names and "fleet.spawn" in names
+    assert "autoscale.heal" in names
+
+    # the replacement warm-started from the store the retirees seeded...
+    spawned = [r for r in fleet.replicas if r.index >= 2]
+    assert len(spawned) == 1 and spawned[0].health == HEALTHY
+    s = spawned[0].engine.stats
+    assert int(s.warmstart_hits) > 0 and float(s.cold_start_s) > 0
+    # ...with replacement isolation: a COLD prefix cache and fresh
+    # per-replica hit-rate accounting, its own stats/pool — no state
+    # leaks across the retire → replace cycle
+    assert int(s.prefix_hits) == 0
+    survivors = [r for r in fleet.replicas if r.health == HEALTHY]
+    assert len({id(r.engine.stats) for r in survivors}) == len(survivors)
+    assert len({id(r.engine.obs) for r in survivors}) == len(survivors)
+
+    # healthy replicas (replacement included) stay bit-identical to a
+    # fault-free solo engine across the whole retire → replace cycle
+    samples = _samples(cfg, n=4, seed=9)
+    fleet_reqs = fleet.generate(samples)
+    fleet.close()
+    solo = ServeEngine(model, params, cfg0, sample_seed=0)
+    solo_reqs = solo.generate(samples)
+    solo.close()
+    assert _tokens(fleet_reqs) == _tokens(solo_reqs)
+
+
+def test_corrupt_warmstart_spawn_falls_back_to_compile_path(stack, tmp_path):
+    cfg, fleet = _ws_fleet(stack, str(tmp_path / "ws2"))
+    trace = make_trace(
+        zoo_spec("bursty_multitenant", n_requests=6, seed=6,
+                 mean_interarrival=1.0), cfg, SRC_V, TRIP_V)
+    plan = FaultPlan((
+        FaultEvent("corrupt_warmstart", at=0),
+        FaultEvent("retire_replica", at=4, replica=1),
+    ), name="corrupt_drill")
+    mon = InvariantMonitor(cfg, expect_recovery=True)
+    scaler = AutoScaler(fleet)
+    report = run_chaos(fleet, trace, plan=plan, monitor=mon, strict=True,
+                       supervisor=scaler)
+
+    assert report.violations == [] and report.replicas_spawned == 1
+    corrupt = next(f for _, n, _, f in fleet.obs.events()
+                   if n == "fault.corrupt_warmstart")
+    assert corrupt["entries"] > 0
+    # the replacement spawned THROUGH the compile path: every store load
+    # was a structured digest_mismatch note, never an exception out of
+    # add_replica — and it still came up HEALTHY at full capacity
+    spawned = [r for r in fleet.replicas if r.index >= 2]
+    assert len(spawned) == 1 and spawned[0].health == HEALTHY
+    s = spawned[0].engine.stats
+    assert int(s.warmstart_misses) > 0
+    reasons = {f["reason"] for _, n, _, f in spawned[0].engine.obs.events()
+               if n == "warmstart_miss"}
+    assert "digest_mismatch" in reasons
+    assert fleet.capacity_frac == 1.0
+    fleet.close()
+
+
+def test_unsupervised_retirement_trips_capacity_recovers(stack):
+    cfg0, model, params = stack
+    fleet = Fleet(model, params, cfg0, replicas=2, sample_seed=0)
+    trace = make_trace(
+        zoo_spec("bursty_multitenant", n_requests=4, seed=7,
+                 mean_interarrival=1.0), cfg0, SRC_V, TRIP_V)
+    plan = FaultPlan((FaultEvent("retire_replica", at=3, replica=1),))
+    mon = InvariantMonitor(cfg0, expect_recovery=True)
+    report = run_chaos(fleet, trace, plan=plan, monitor=mon, strict=False)
+    assert fleet.capacity_frac == 0.5  # nobody healed
+    assert "capacity_recovers" in {v["invariant"] for v in report.violations}
+    assert report.replicas_spawned == 0
+
+    # no_double_serve: a resubmit whose source never retired is flagged
+    fresh = InvariantMonitor(cfg0)
+    fleet.obs.emit("fleet.resubmit", id=999, replica=0, from_replica=0)
+    violations = fresh.check(fleet)
+    assert "no_double_serve" in {v.invariant for v in violations}
+    fleet.close()
+
+
+# ---------------------------------------------------------------------------
+# slow randomized scale storm: strict monitor, zero violations, every seed
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.slow
+@pytest.mark.autoscale
+def test_scale_storm_property(stack, tmp_path):
+    """Seeded random retire schedules crossed with zoo traces on a
+    2-replica warm-started fleet with the supervisor attached: every run
+    must finish STRICT-clean with capacity healed to 1.0 — the
+    ``expect_recovery`` monitor makes a missed heal a violation, not a
+    silent degradation."""
+    root = str(tmp_path / "ws_storm")  # shared store: later seeds warm
+    for seed in range(2):
+        cfg, fleet = _ws_fleet(stack, root)
+        spec = zoo_spec(
+            ["bursty_multitenant", "duplicate_storm"][seed % 2],
+            n_requests=6, seed=50 + seed, mean_interarrival=1.0)
+        plan = FaultPlan((
+            FaultEvent("retire_replica", at=3 + seed, replica=1),
+        ), name=f"storm{seed}")
+        mon = InvariantMonitor(cfg, expect_recovery=True)
+        scaler = AutoScaler(fleet)
+        report = run_chaos(fleet, make_trace(spec, cfg, SRC_V, TRIP_V),
+                           plan=plan, monitor=mon, strict=True,
+                           supervisor=scaler)
+        assert report.violations == []
+        assert fleet.capacity_frac == 1.0 and scaler.heals >= 1
+        fleet.close()

@@ -67,12 +67,12 @@ def test_alive_tpu_best_variant_wins(bench, monkeypatch, capsys):
     monkeypatch.setattr(bench, "_run_child", fake_child)
     out = _run_main(bench, capsys)
     assert out["device"] == "tpu"
-    # the 4th variant wins: the 5th-8th (bucketed 104, serve 105, fleet
-    # 106, chaos 107) are excluded from the headline pool — vs_baseline
-    # stays defined on the padded-credit fixed-shape protocol
+    # the 4th variant wins: the 5th-9th (bucketed 104, serve 105, fleet
+    # 106, chaos 107, autoscale 108) are excluded from the headline pool —
+    # vs_baseline stays defined on the padded-credit fixed-shape protocol
     assert out["value"] == 103.0
     assert "degraded" not in out
-    assert len(out["all_variants"]) == 8
+    assert len(out["all_variants"]) == 9
     # one probe + ONE serve for the whole device group (single claim)
     assert [c[0] for c in calls] == ["--probe", "--serve"]
 
@@ -262,6 +262,69 @@ def test_chaos_violations_mark_artifact_degraded(bench, monkeypatch, capsys):
     assert "chaos" in out.get("notes", "")
 
 
+def test_autoscale_record_fields_survive_embedding(bench, monkeypatch, capsys):
+    """An autoscale-mode child record's elastic-fleet fields (recovery
+    clock, warm-vs-cold bring-up, spawn/heal counters, warm-start store
+    hit accounting) must survive into the final JSON's all_variants —
+    they carry the ISSUE 13 self-healing-fleet claim."""
+    auto_fields = {"trace": "bursty_multitenant",
+                   "fault_plan": ["retire_replica"],
+                   "chaos_violations": 0, "invariant_checks": 9,
+                   "capacity_frac": 1.0, "time_to_recover_s": 2.31,
+                   "replicas_spawned": 1, "heals": 1,
+                   "cold_start_cold_s": 1.7, "cold_start_warm_s": 1.27,
+                   "warm_vs_cold": 0.747,
+                   "warmstart_hits": 5, "warmstart_misses": 5,
+                   "resubmissions": 2,
+                   "outcomes": {"OK": 5, "SHED": 1}}
+
+    def fake_child(args, timeout_s, cpu_only=False):
+        if args[0] == "--probe":
+            return {"ok": True, "platform": "tpu", "n_devices": 1}, None
+        for spec in args[1].split(","):
+            _emit(bench, {"phase": "start", "spec": spec})
+            rec = _result(spec, 100.0)
+            if rec["mode"] == "autoscale":
+                rec.update(auto_fields, nonterminal_after_drain=0)
+            _emit(bench, rec)
+        _emit(bench, {"phase": "done"})
+        return {"ok": True, "phase": "done"}, None
+
+    monkeypatch.setattr(bench, "_run_child", fake_child)
+    out = _run_main(bench, capsys)
+    auto_recs = [v for v in out["all_variants"] if v["mode"] == "autoscale"]
+    assert auto_recs, "spec list must carry an autoscale variant"
+    for v in auto_recs:
+        for k, want in auto_fields.items():
+            assert v[k] == want, (k, v)
+    assert "degraded" not in out  # zero violations: artifact stays clean
+
+
+def test_autoscale_violations_mark_artifact_degraded(bench, monkeypatch,
+                                                     capsys):
+    """The autoscale drill rides the same chaos_violations gate: a run
+    whose capacity never recovered (capacity_recovers violation) must
+    degrade the whole artifact, never publish silently."""
+
+    def fake_child(args, timeout_s, cpu_only=False):
+        if args[0] == "--probe":
+            return {"ok": True, "platform": "tpu", "n_devices": 1}, None
+        for spec in args[1].split(","):
+            _emit(bench, {"phase": "start", "spec": spec})
+            rec = _result(spec, 100.0)
+            if rec["mode"] == "autoscale":
+                rec.update(chaos_violations=1,
+                           violation_invariants=["capacity_recovers"])
+            _emit(bench, rec)
+        _emit(bench, {"phase": "done"})
+        return {"ok": True, "phase": "done"}, None
+
+    monkeypatch.setattr(bench, "_run_child", fake_child)
+    out = _run_main(bench, capsys)
+    assert out["degraded"] is True
+    assert "capacity_recovers" in out.get("notes", "")
+
+
 def test_killed_serve_retries_untried_first(bench, monkeypatch, capsys):
     """A serve child killed mid-variant: the retry round runs the missing
     specs with the killed one LAST, and the final JSON carries both the
@@ -290,7 +353,7 @@ def test_killed_serve_retries_untried_first(bench, monkeypatch, capsys):
     monkeypatch.setattr(bench, "_run_child", fake_child)
     out = _run_main(bench, capsys)
     assert state["round"] == 2
-    assert len(out["all_variants"]) == 8
+    assert len(out["all_variants"]) == 9
     assert out["value"] == 300.0
     assert "killed during" not in out.get("notes", "")  # retried successfully
 
@@ -316,7 +379,7 @@ def test_deterministic_error_not_retried(bench, monkeypatch, capsys):
     out = _run_main(bench, capsys)
     assert state["serves"] == 1  # error is final: no retry round
     assert "non-finite" in out["notes"]
-    assert len(out["all_variants"]) == 7
+    assert len(out["all_variants"]) == 8
 
 
 def test_malformed_bench_variants_flagged(bench, monkeypatch, capsys):
@@ -358,7 +421,7 @@ def test_done_record_authoritative_over_stdout_marker(bench, monkeypatch, capsys
     out = _run_main(bench, capsys)
     assert state["serves"] == 1  # done record suppressed the retry round
     assert "serve:" not in out.get("notes", "")
-    assert len(out["all_variants"]) == 8
+    assert len(out["all_variants"]) == 9
     assert "degraded" not in out
 
 

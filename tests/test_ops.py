@@ -424,7 +424,11 @@ class TestStaticFleetBoundary:
     engine-internal refactors."""
 
     ROOT = pathlib.Path(__file__).resolve().parent.parent
-    FILES = ("csat_tpu/serve/fleet.py", "csat_tpu/serve/router.py")
+    # autoscale.py + warmstart.py joined in ISSUE 13: the supervisor reads
+    # fleet/engine metrics and the warm-start store feeds engine bring-up,
+    # both strictly through public surfaces
+    FILES = ("csat_tpu/serve/fleet.py", "csat_tpu/serve/router.py",
+             "csat_tpu/serve/autoscale.py", "csat_tpu/serve/warmstart.py")
 
     def test_no_private_attribute_reach_through(self):
         offenders = []

@@ -29,10 +29,12 @@ import sys
 from typing import List, Tuple
 
 # event-name prefixes that make the condensed timeline: injected faults,
-# the degradation ladder acting, and the invariant monitor's verdicts
+# the degradation ladder acting, the invariant monitor's verdicts, and
+# the elastic-fleet lifecycle (spawn/heal — ISSUE 13)
 TIMELINE_PREFIXES = (
     "fault.", "invariant.", "req.brownout", "fleet.shed_oldest",
     "fleet.retire", "fleet.resubmit", "fleet.backoff", "fleet.draining",
+    "fleet.spawn", "autoscale.",
 )
 
 

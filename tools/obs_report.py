@@ -140,24 +140,37 @@ _FLEET_COLS = (
 )
 
 
+# serve_health_state gauge encoding (csat_tpu/serve/fleet.py)
+_HEALTH_NAMES = {0: "HEALTHY", 1: "DRAINING", 2: "SICK"}
+
+
 def split_fleet_snapshot(snap: dict) -> List[dict]:
     """One fleet snapshot (``Fleet.snapshot`` — per-replica series under a
-    ``replica<k>_`` key prefix) → per-replica plain dicts, index order."""
+    ``replica<k>_`` key prefix) → per-replica plain dicts, index order.
+    Each dict carries its replica index under ``_index`` — elastic fleets
+    (ISSUE 13) have gaps: retired indices disappear, replacements land at
+    fresh monotonic indices, so list position is not identity."""
     per: Dict[int, dict] = {}
     for key, v in snap.items():
         m = _REPLICA_KEY_RE.match(key)
         if m:
             per.setdefault(int(m.group(1)), {})[m.group(2)] = v
+    for k, d in per.items():
+        d["_index"] = k
     return [per[k] for k in sorted(per)]
 
 
 def fleet_table(snaps: List[dict]) -> str:
-    """Per-replica counter table plus a summed fleet totals row, from the
-    replicas' last metrics snapshots."""
+    """Per-replica counter table (with the health state from each
+    replica's ``serve_health_state`` gauge) plus a summed fleet totals
+    row, from the replicas' last metrics snapshots."""
     rows: List[Tuple] = []
     totals = {col: 0 for col, _ in _FLEET_COLS}
     for k, snap in enumerate(snaps):
-        row: List = [f"replica{k}"]
+        row: List = [f"replica{snap.get('_index', k)}"]
+        health = snap.get("serve_health_state")
+        row.append(_HEALTH_NAMES.get(health, "-") if health is not None
+                   else "-")
         for col, key in _FLEET_COLS:
             v = snap.get(key, 0) or 0
             row.append(v)
@@ -166,9 +179,10 @@ def fleet_table(snaps: List[dict]) -> str:
         lat_s = snap.get("serve_request_latency_seconds_sum") or 0.0
         row.append(round(lat_s / lat_n * 1e3, 1) if lat_n else "-")
         rows.append(tuple(row))
-    rows.append(("fleet", *(totals[c] for c, _ in _FLEET_COLS), "-"))
+    rows.append(("fleet", "-", *(totals[c] for c, _ in _FLEET_COLS), "-"))
     return _fmt_table(
-        rows, ("replica", *(c for c, _ in _FLEET_COLS), "lat_mean_ms"))
+        rows,
+        ("replica", "health", *(c for c, _ in _FLEET_COLS), "lat_mean_ms"))
 
 
 def history_table(history: List[dict]) -> str:
@@ -209,13 +223,26 @@ def report(metrics_path: Optional[str] = None,
         # serve CLI's --replicas N --metrics_file output) or N per-replica
         # metrics files, comma-separated
         snaps: List[dict] = []
+        spawned = retired = 0
+        lifecycle = False
         for path in fleet_paths:
             all_snaps = load_metrics(path)
             last = all_snaps[-1] if all_snaps else {}
             split = split_fleet_snapshot(last)
             snaps.extend(split if split else [last])
-        sections.append(
-            f"== fleet ({len(snaps)} replica(s)) ==\n" + fleet_table(snaps))
+            # fleet-level lifecycle counters ride un-prefixed in the same
+            # snapshot as the replica<k>_ series (elastic fleet, ISSUE 13)
+            if ("fleet_replicas_spawned_total" in last
+                    or "fleet_replicas_retired_total" in last):
+                lifecycle = True
+                spawned += int(last.get("fleet_replicas_spawned_total", 0))
+                retired += int(last.get("fleet_replicas_retired_total", 0))
+        section = (f"== fleet ({len(snaps)} replica(s)) ==\n"
+                   + fleet_table(snaps))
+        if lifecycle:
+            section += (f"\nlifecycle: {spawned} spawned, "
+                        f"{retired} retired")
+        sections.append(section)
     if metrics_path:
         snaps = load_metrics(metrics_path)
         if snaps:
