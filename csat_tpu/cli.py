@@ -26,9 +26,10 @@ import jax
 
 
 def main() -> None:
-    # subcommand dispatch: `serve` / `summarize` go to the inference CLI
-    # (csat_tpu/serve/cli.py); everything else is the legacy train/test path
-    if len(sys.argv) > 1 and sys.argv[1] in ("serve", "summarize"):
+    # subcommand dispatch: `serve` / `summarize` / `top` go to the
+    # inference CLI (csat_tpu/serve/cli.py); everything else is the
+    # legacy train/test path
+    if len(sys.argv) > 1 and sys.argv[1] in ("serve", "summarize", "top"):
         from csat_tpu.serve.cli import main as serve_main
 
         serve_main(sys.argv[1:])

@@ -391,6 +391,30 @@ class Config:
     obs_metrics_file: str = ""
     # snapshot/heartbeat cadence for obs_metrics_file, seconds
     obs_metrics_every_s: float = 10.0
+    # --- request tracing + SLOs (csat_tpu/obs/{rtrace,slo}.py; ISSUE 14) ---
+    # finished request traces retained in the bounded ring (newest kept);
+    # 0 disables tracing entirely: submit mints "" and every span call is
+    # guarded out — the bench's tracing_overhead_pct measures the on path
+    obs_traces: int = 256
+    # high-water set: the N longest traces kept even after ring eviction
+    # (what `obs_report --traces` and `csat_tpu top` surface first)
+    obs_trace_slowest: int = 8
+    # availability objective: target fraction of terminal requests OK
+    slo_availability: float = 0.999
+    # latency objective threshold per priority class, seconds (entry p →
+    # class p; a shorter tuple reuses its last entry; () = no latency
+    # objectives). Observe-only: alerts are events, never scheduling
+    slo_latency_s: Tuple[float, ...] = ()
+    # latency objectives' target fraction (of class-p OK requests under
+    # the class threshold)
+    slo_latency_target: float = 0.95
+    # multi-window burn-rate alerting (SRE pattern): alert only when BOTH
+    # the fast (sensitive) and slow (stubborn) window burns exceed their
+    # thresholds; burn 1.0 = spending the error budget exactly on schedule
+    slo_fast_window_s: float = 60.0
+    slo_slow_window_s: float = 300.0
+    slo_burn_fast: float = 14.0
+    slo_burn_slow: float = 6.0
     # --- perf observatory (csat_tpu/obs/{calibrate,perfdb}.py; ISSUE 10) ---
     # hardware calibration probes run at the top of every bench session
     # (device FLOPs / memory bandwidth / dispatch latency / compile
@@ -562,6 +586,16 @@ class Config:
         assert self.snapshot_every_steps >= 0, self.snapshot_every_steps
         assert self.obs_events >= 0, self.obs_events
         assert self.obs_metrics_every_s > 0, self.obs_metrics_every_s
+        assert self.obs_traces >= 0, self.obs_traces
+        assert self.obs_trace_slowest >= 0, self.obs_trace_slowest
+        assert 0 < self.slo_availability < 1, self.slo_availability
+        assert all(t > 0 for t in self.slo_latency_s), self.slo_latency_s
+        assert 0 < self.slo_latency_target < 1, self.slo_latency_target
+        assert self.slo_fast_window_s > 0, self.slo_fast_window_s
+        assert self.slo_slow_window_s >= self.slo_fast_window_s, (
+            self.slo_slow_window_s)
+        assert self.slo_burn_fast > 0, self.slo_burn_fast
+        assert self.slo_burn_slow > 0, self.slo_burn_slow
         from csat_tpu.obs.calibrate import PROBES as _CALIB_PROBES
 
         assert all(p in _CALIB_PROBES for p in self.calib_probes), (

@@ -13,6 +13,13 @@ layer.
 * ``obs/trace.py`` — exports recorder spans as Chrome/Perfetto
   trace-event JSON and brackets them with ``jax.profiler.TraceAnnotation``
   so host phases line up with device traces from ``--profile``.
+* ``obs/rtrace.py`` — request-scoped tracing (ISSUE 14): one bounded
+  trace per submitted request with spans for every serving phase, linked
+  attempt-numbered across fleet resubmission; histogram exemplars tie
+  aggregate latency back to concrete traces.
+* ``obs/slo.py`` — declarative SLOs (availability + per-priority-class
+  latency) with multi-window burn-rate alerting computed from the
+  existing registry; alerts are observe-only recorder events.
 * ``obs/calibrate.py`` — seeded hardware calibration probes (device
   FLOPs, memory bandwidth, dispatch latency, compile throughput) and the
   machine fingerprint stamped into every bench record (ISSUE 10).
@@ -39,6 +46,17 @@ from csat_tpu.obs.metrics import (  # noqa: F401
     MetricsFile,
     MetricsRegistry,
     merge_histograms,
+)
+from csat_tpu.obs.rtrace import (  # noqa: F401
+    Tracer,
+    TraceRecord,
+    TraceSpan,
+    load_traces,
+)
+from csat_tpu.obs.slo import (  # noqa: F401
+    Objective,
+    SLOEngine,
+    objectives_from_config,
 )
 from csat_tpu.obs.trace import (  # noqa: F401
     load_chrome_trace,

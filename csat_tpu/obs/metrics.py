@@ -86,14 +86,24 @@ class Gauge:
         return [(self.name, self.value)]
 
 
+# global recency stamp for histogram exemplars: lets merge_histograms
+# keep the newest trace id per bucket without reading any clock
+_EXEMPLAR_SEQ = iter(range(1, 1 << 62)).__next__
+
+
 class Histogram:
     """Fixed-bucket histogram with Prometheus cumulative-``le`` exposition.
 
     ``observe`` is two int adds and a bisect — cheap enough for per-request
-    latency recording on the serving path."""
+    latency recording on the serving path.  An optional *exemplar* (a
+    request trace id, ISSUE 14) is retained per bucket — newest wins — so
+    "p95 regressed" jumps straight to a concrete trace; exemplars ride the
+    JSONL snapshot (only when present) and never change the byte-stable
+    Prometheus exposition."""
 
     kind = "histogram"
-    __slots__ = ("name", "help", "buckets", "counts", "sum", "count")
+    __slots__ = ("name", "help", "buckets", "counts", "sum", "count",
+                 "exemplars")
 
     def __init__(self, name: str, help: str = "",
                  buckets: Sequence[float] = DEFAULT_BUCKETS):
@@ -105,11 +115,28 @@ class Histogram:
         self.counts = [0] * (len(self.buckets) + 1)
         self.sum = 0.0
         self.count = 0
+        # lazily allocated [(exemplar_id, value, seq) | None] per bucket —
+        # None until the first exemplar so plain histograms pay nothing
+        self.exemplars: Optional[List[Optional[Tuple[str, float, int]]]] = None
 
-    def observe(self, v: float) -> None:
-        self.counts[bisect.bisect_left(self.buckets, v)] += 1
+    def observe(self, v: float, exemplar: Optional[str] = None) -> None:
+        i = bisect.bisect_left(self.buckets, v)
+        self.counts[i] += 1
         self.sum += v
         self.count += 1
+        if exemplar:
+            if self.exemplars is None:
+                self.exemplars = [None] * len(self.counts)
+            self.exemplars[i] = (exemplar, v, _EXEMPLAR_SEQ())
+
+    def exemplar_items(self) -> List[Tuple[str, str, float]]:
+        """``(le_label, exemplar_id, observed_value)`` per populated bucket
+        (``le`` formatted like the exposition labels; overflow = "+Inf")."""
+        if self.exemplars is None:
+            return []
+        labels = [_fmt(b) for b in self.buckets] + ["+Inf"]
+        return [(labels[i], ex[0], ex[1])
+                for i, ex in enumerate(self.exemplars) if ex is not None]
 
     def samples(self) -> List[Tuple[str, Union[int, float]]]:
         out: List[Tuple[str, Union[int, float]]] = []
@@ -170,6 +197,12 @@ class MetricsRegistry:
                   buckets: Sequence[float] = DEFAULT_BUCKETS) -> Histogram:
         return self._get(Histogram, name, help, buckets=buckets)
 
+    def get(self, name: str) -> Optional[Union[Counter, Gauge, Histogram]]:
+        """Registered metric by exposition name, or None — the read-only
+        lookup external consumers (``obs/slo.py``) use instead of the
+        get-or-create constructors (which would register phantom series)."""
+        return self._metrics.get(name)
+
     def __iter__(self):
         return iter(self._metrics.values())
 
@@ -208,6 +241,12 @@ class MetricsRegistry:
             if isinstance(m, Histogram):
                 out[f"{prefix}{m.name}_sum"] = round(m.sum, 6)
                 out[f"{prefix}{m.name}_count"] = m.count
+                if m.exemplars is not None:
+                    # only when traced requests actually landed — plain
+                    # histograms keep the pinned two-key snapshot shape
+                    out[f"{prefix}{m.name}_exemplars"] = {
+                        le: [ex, round(val, 6)]
+                        for le, ex, val in m.exemplar_items()}
             else:
                 v = m.value
                 out[f"{prefix}{m.name}"] = (
@@ -234,6 +273,14 @@ def merge_histograms(hists: Sequence[Histogram], name: str = "",
             out.counts[i] += c
         out.sum += h.sum
         out.count += h.count
+        if h.exemplars is not None:
+            if out.exemplars is None:
+                out.exemplars = [None] * len(out.counts)
+            for i, ex in enumerate(h.exemplars):
+                # newest exemplar per bucket wins across replicas
+                if ex is not None and (out.exemplars[i] is None
+                                       or ex[2] > out.exemplars[i][2]):
+                    out.exemplars[i] = ex
     return out
 
 

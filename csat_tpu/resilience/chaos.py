@@ -261,6 +261,8 @@ class ChaosReport:
     # to 1.0, in the target's clock; -1.0 = never dropped / never recovered
     time_to_recover_s: float = -1.0
     replicas_spawned: int = 0
+    # SLO burn-rate alerts (ISSUE 14): objective name -> times fired
+    slo_alerts: Dict[str, int] = dataclasses.field(default_factory=dict)
 
     @property
     def clean(self) -> bool:
@@ -283,6 +285,7 @@ class ChaosReport:
                 "resubmissions": self.resubmissions,
                 "time_to_recover_s": self.time_to_recover_s,
                 "replicas_spawned": self.replicas_spawned,
+                "slo_alerts": self.slo_alerts,
                 "trace_spec": self.trace_json, "fault_plan": self.plan_json,
             }}) + "\n")
             for rec in self.timeline:
@@ -323,6 +326,7 @@ def run_chaos(
     strict: bool = True,
     tick_budget: int = 0,
     supervisor: Any = None,
+    slo: Any = None,
 ) -> ChaosReport:
     """Drive ``target`` (engine or fleet) through ``trace`` with ``plan``'s
     faults firing on schedule, the monitor observing every tick, and a
@@ -333,7 +337,10 @@ def run_chaos(
     record degraded instead of crashing the run.  ``supervisor`` (an
     :class:`~csat_tpu.serve.autoscale.AutoScaler` or anything with a
     ``step()``) is stepped once per loop iteration, so healing happens
-    under the same trace pressure the faults fire into."""
+    under the same trace pressure the faults fire into.  ``slo`` (an
+    :class:`~csat_tpu.obs.slo.SLOEngine`) is likewise stepped per
+    iteration; its fired-alert counts land in ``ChaosReport.slo_alerts``
+    and its transitions in the merged timeline."""
     cfg = target.cfg
     injectors = plan.apply(target) if plan is not None else {}
     del injectors  # installed on the engines; the report reads the events
@@ -378,6 +385,8 @@ def run_chaos(
             cap_drop_t = target.clock()
         if supervisor is not None:
             supervisor.step()
+        if slo is not None:
+            slo.step()
         if is_fleet:
             cap = target.capacity_frac
             if cap < 1.0 and cap_drop_t is None:
@@ -447,6 +456,7 @@ def run_chaos(
         time_to_recover_s=round(recover_s, 4) if recover_s >= 0 else -1.0,
         replicas_spawned=(len(target.replicas) - n_replicas0
                           if is_fleet else 0),
+        slo_alerts=dict(slo.fired) if slo is not None else {},
     )
     if strict and monitor is not None:
         monitor.assert_clean(report)

@@ -53,9 +53,15 @@ class AutoScaler:
     of actions taken (``"heal" | "up" | "down"``), empty when idle."""
 
     def __init__(self, fleet: Any, cfg: Optional[Config] = None,
-                 log: Callable[[str], None] = lambda m: None):
+                 log: Callable[[str], None] = lambda m: None,
+                 slo: Any = None):
         self.fleet = fleet
         self.cfg = cfg if cfg is not None else fleet.cfg
+        # optional SLO engine (obs/slo.py, ISSUE 14): when set, each
+        # heal/up/down event carries the objectives firing at decision
+        # time, so burn-rate pressure and the supervisor's response sit
+        # on the same timeline row
+        self.slo = slo
         c = self.cfg
         self.min_replicas = c.serve_min_replicas
         # ceiling defaults to the constructed size so `--autoscale` on a
@@ -91,7 +97,8 @@ class AutoScaler:
             rep = f.add_replica()
             self.heals += 1
             f.obs.emit("autoscale.heal", ok=int(rep is not None),
-                       healthy=len(f.healthy_replicas), target=want)
+                       healthy=len(f.healthy_replicas), target=want,
+                       **self._slo_fields())
             return ["heal"]
 
         qfrac, page_occ, p95, busy = self._signals(healthy)
@@ -116,7 +123,8 @@ class AutoScaler:
             self._over = 0
             f.obs.emit("autoscale.up", ok=int(rep is not None),
                        target=f.target_replicas, queue_frac=round(qfrac, 3),
-                       page_occ=round(page_occ, 3), p95_s=round(p95, 4))
+                       page_occ=round(page_occ, 3), p95_s=round(p95, 4),
+                       **self._slo_fields())
             self.log(f"# autoscale: up → target {f.target_replicas} "
                      f"(queue/slot {qfrac:.2f}, pages {page_occ:.2f}, "
                      f"p95 {p95:.3f}s)")
@@ -135,13 +143,18 @@ class AutoScaler:
             self._under = 0
             f.obs.emit("autoscale.down", replica=victim.index,
                        target=f.target_replicas, queue_frac=round(qfrac, 3),
-                       busy_frac=round(busy, 3))
+                       busy_frac=round(busy, 3), **self._slo_fields())
             self.log(f"# autoscale: down → target {f.target_replicas} "
                      f"(draining replica {victim.index})")
             return ["down"]
         return []
 
     # ---------------- signals + rate limits ----------------
+
+    def _slo_fields(self) -> dict:
+        if self.slo is None or not self.slo.alerts:
+            return {}
+        return {"slo_firing": ",".join(sorted(self.slo.alerts))}
 
     def _signals(self, healthy: List[Any]):
         """(queue per healthy slot, worst page occupancy, class-0 p95,

@@ -111,6 +111,17 @@ def _parser() -> argparse.ArgumentParser:
                    help="on exit, export the engine's recorded phase spans "
                         "as Chrome trace-event JSON here (load in "
                         "chrome://tracing or ui.perfetto.dev)")
+    p.add_argument("--traces_file", default="",
+                   help="on exit, dump the request tracer's slowest and "
+                        "still-active traces as JSONL here (render with "
+                        "`csat_tpu top --traces ...` or "
+                        "`tools/obs_report.py --traces ...`)")
+    p.add_argument("--slo", action="store_true",
+                   help="serve: step the burn-rate SLO engine (obs/slo.py, "
+                        "objectives from the slo_* config knobs) against "
+                        "the live metrics — alert transitions land in the "
+                        "flight recorder, burn gauges in the metrics "
+                        "snapshots")
     p.add_argument("--postmortem_dir", default="",
                    help="where fault post-mortem event dumps land (default: "
                         "config obs_postmortem_dir)")
@@ -235,6 +246,8 @@ def _telemetry(engine, cfg, args):
             writer.maybe_write(extra=extra(), force=True)
         if getattr(args, "trace_file", ""):
             write_chrome_trace(args.trace_file, engine.obs)
+        if getattr(args, "traces_file", ""):
+            engine.tracer.dump(args.traces_file)
 
     return writer, extra, finalize
 
@@ -397,6 +410,13 @@ def _serve(args) -> None:
 
         scaler = AutoScaler(engine, cfg,
                             log=lambda m: print(m, file=sys.stderr))
+    slo = None
+    if args.slo:
+        from csat_tpu.obs.slo import SLOEngine
+
+        slo = SLOEngine.for_target(engine, cfg)
+        if scaler is not None:
+            scaler.slo = slo  # stamp active alerts into scaling decisions
     import jax
 
     n_chips = jax.device_count()
@@ -484,6 +504,8 @@ def _serve(args) -> None:
                 # every iteration, not just busy ones — healing a retired
                 # replica must not wait for the next request to arrive
                 scaler.step()
+            if slo is not None:
+                slo.step()
             flush_finished(pending)
             if writer is not None:
                 writer.maybe_write(extra=extra())
@@ -493,6 +515,8 @@ def _serve(args) -> None:
                 hb = {k: s[k] for k in hb_keys}
                 hb.update(queue_depth=engine.queue_depth,
                           occupancy=engine.occupancy)
+                if slo is not None and slo.alerts:
+                    hb["slo_alerts"] = sorted(slo.alerts)
                 print(f"# heartbeat {json.dumps(hb)}", file=sys.stderr)
     engine.close()
     finalize()
@@ -501,9 +525,20 @@ def _serve(args) -> None:
 
 def main(argv: Optional[List[str]] = None) -> None:
     argv = list(sys.argv[1:] if argv is None else argv)
-    if not argv or argv[0] not in ("serve", "summarize"):
-        raise SystemExit("usage: csat_tpu serve|summarize [options] [files ...]")
+    if not argv or argv[0] not in ("serve", "summarize", "top"):
+        raise SystemExit(
+            "usage: csat_tpu serve|summarize|top [options] [files ...]")
     command = argv.pop(0)
+    if command == "top":
+        # the live console lives with the other artifact readers in
+        # tools/ — a sibling of the csat_tpu package in the repo layout
+        root = os.path.dirname(os.path.dirname(
+            os.path.dirname(os.path.abspath(__file__))))
+        if root not in sys.path:
+            sys.path.insert(0, root)
+        from tools.serve_top import main as top_main
+
+        raise SystemExit(top_main(argv))
     args = _parser().parse_args(argv)
     if command == "summarize":
         _summarize(args)

@@ -67,7 +67,7 @@ import numpy as np
 from csat_tpu.configs import Config
 from csat_tpu.data.vocab import Vocab
 from csat_tpu.models import CSATrans
-from csat_tpu.obs import EventRecorder
+from csat_tpu.obs import EventRecorder, Tracer
 from csat_tpu.resilience.retry import ErrorBudget
 from csat_tpu.resilience.watchdog import StepWatchdog
 from csat_tpu.serve.ingest import PoisonRequestError, validate_sample
@@ -149,6 +149,8 @@ class Request:
     phash: Optional[bytes] = None   # content hash (prefix cache on): computed
     #                                 ONCE at submit — admission may re-plan a
     #                                 deferred request every tick
+    trace_id: str = ""              # request trace (obs/rtrace.py); "" when
+    #                                 tracing is off — span calls guard on it
 
     @property
     def finished(self) -> bool:
@@ -157,6 +159,15 @@ class Request:
     @property
     def ok(self) -> bool:
         return self.status == RequestStatus.OK
+
+
+def _tf(req: "Request") -> Dict[str, str]:
+    """Trace-id fields for recorder events: every lifecycle event carries
+    the request's trace id so postmortem dumps and chaos timelines
+    cross-reference request traces (and vice versa — trace spans carry
+    request ids).  Empty when tracing is off, so the disabled path emits
+    byte-identical events to pre-tracing builds."""
+    return {"trace": req.trace_id} if req.trace_id else {}
 
 
 @dataclasses.dataclass
@@ -210,6 +221,14 @@ class ServeEngine:
         # ring; any fault path schedules a post-mortem dump of the ring so
         # an incident leaves a timeline. All host-side — no device syncs.
         self.obs = EventRecorder(capacity=cfg.obs_events, component="serve")
+        # request-scoped tracing (obs/rtrace.py, ISSUE 14): submit mints a
+        # trace id, lifecycle phases land as spans in the ENGINE clock
+        # domain (self.clock — virtual-clock drills stay coherent).  A
+        # fleet replaces this with its shared tracer so traces survive
+        # replica retirement.  capacity 0 → begin mints "" and every span
+        # call below is guarded out
+        self.tracer = Tracer(capacity=cfg.obs_traces,
+                             slowest=cfg.obs_trace_slowest, component="serve")
         pm = cfg.obs_postmortem_dir
         self._postmortem_dir = (
             os.path.join(cfg.output_dir, "postmortem") if pm == "auto" else pm)
@@ -466,6 +485,7 @@ class ServeEngine:
         max_new_tokens: int = 0,
         deadline_s: Optional[float] = None,
         priority: int = 0,
+        trace_id: Optional[str] = None,
     ) -> int:
         """Queue one request; returns its id — ALWAYS, even when the
         request is refused: admission control and the poison quarantine
@@ -478,6 +498,10 @@ class ServeEngine:
         latency.  ``priority`` is the tenant tier (0 = most important,
         clamped to ``cfg.serve_priority_classes``): under pressure the
         highest-numbered tier is brownout-capped first and shed first.
+
+        ``trace_id`` adopts an existing request trace (the fleet mints one
+        before routing so the whole attempt chain shares a trace); None
+        mints a fresh one (or ``""`` with tracing disabled).
 
         The only exception path is budget exhaustion: a stream whose
         poison count exceeds ``cfg.serve_poison_budget`` raises
@@ -492,7 +516,10 @@ class ServeEngine:
             deadline_t=(now + ddl) if ddl and ddl > 0 else None)
         self._next_id += 1
         self.stats.submitted += 1
-        self.obs.emit("req.submit", id=req.id, limit=limit, priority=pr)
+        req.trace_id = self.tracer.begin(trace_id, t=now, id=req.id,
+                                         priority=pr, limit=limit)
+        self.obs.emit("req.submit", id=req.id, limit=limit, priority=pr,
+                      **_tf(req))
         if req.deadline_t is not None:
             self._has_deadlines = True
 
@@ -503,7 +530,7 @@ class ServeEngine:
             # raises DataErrorBudgetExceeded once the budget is spent
             self._poison_budget([req.id], e)
             self.stats.quarantined = self._poison_budget.count
-            self.obs.emit("fault.poison", id=req.id, error=str(e))
+            self.obs.emit("fault.poison", id=req.id, error=str(e), **_tf(req))
             self._finish(req, RequestStatus.FAILED,
                          error=f"poison request: {e}", now=now)
             self._flush_postmortems()
@@ -525,7 +552,10 @@ class ServeEngine:
                 req.browned = True
                 self.stats.browned += 1
                 self.obs.emit("req.brownout", id=req.id, limit=cap,
-                              priority=req.priority)
+                              priority=req.priority, **_tf(req))
+                if req.trace_id:
+                    self.tracer.event(req.trace_id, "brownout", t=now,
+                                      limit=cap)
 
         # admission control: bounded queue with a structured outcome
         if max_q and len(self._queue) >= max_q:
@@ -793,8 +823,10 @@ class ServeEngine:
         req.sample = None  # release the (N, N) payload
         if status == RequestStatus.OK:
             self.stats.record_request(req.submit_t, req.admit_t, now,
-                                      req.n_tokens, priority=req.priority)
-            self.obs.emit("req.ok", id=req.id, n_tokens=req.n_tokens)
+                                      req.n_tokens, priority=req.priority,
+                                      trace_id=req.trace_id)
+            self.obs.emit("req.ok", id=req.id, n_tokens=req.n_tokens,
+                          **_tf(req))
         else:
             if status in (RequestStatus.REJECTED, RequestStatus.SHED):
                 req.retry_after_s = self._retry_hint()
@@ -803,10 +835,19 @@ class ServeEngine:
             # the dump that follows includes this transition in its timeline
             self.obs.emit("req." + status.lower(), id=req.id,
                           n_tokens=req.n_tokens, error=error,
-                          retry_after_s=req.retry_after_s)
+                          retry_after_s=req.retry_after_s, **_tf(req))
             self._note_fault(status)
             if error:
                 self.log(f"# serve: request {req.id} {status}: {error}")
+        if req.trace_id:
+            # the decode segment spans admission → retirement (admitted
+            # requests only — queue-resolved outcomes never decoded)
+            if req.admit_t is not None:
+                self.tracer.span_from(req.trace_id, "decode", req.admit_t,
+                                      now, n_tokens=req.n_tokens)
+            self.tracer.finish(req.trace_id, status, t=now,
+                               n_tokens=req.n_tokens, id=req.id,
+                               **({"error": error} if error else {}))
         self._results[req.id] = req
 
     def _finish_slot(self, i: int, status: str, error: Optional[str] = None,
@@ -1184,9 +1225,17 @@ class ServeEngine:
             self._prefill_progs[k] = prog
             self.stats.record_compile("prefill", (spec.n, spec.batch_size))
         t0 = time.perf_counter()
+        traced = any(r.trace_id for r in chunk)
+        c0 = self.clock() if traced else 0.0
         self._pool = prog(self._dparams, batch, ids, limits, ordinal,
                           self._pool)
         self.obs.span_from(f"prefill.n{spec.n}", t0, rows=len(chunk))
+        if traced:
+            c1 = self.clock()
+            for req in chunk:
+                if req.trace_id:
+                    self.tracer.span_from(req.trace_id, f"prefill.n{spec.n}",
+                                          c0, c1, rows=len(chunk))
         self.stats.prefill_calls += 1
         self._mark_admitted(chunk, slot_ids, plans)
 
@@ -1247,9 +1296,18 @@ class ServeEngine:
                 self._prefill_progs[k] = prog
                 self.stats.record_compile("prefill", (spec.n, spec.batch_size))
             t0 = time.perf_counter()
+            traced = any(req.trace_id for req, _, _ in misses)
+            c0 = self.clock() if traced else 0.0
             self._pool = prog(self._dparams, batch, ids, limits, self_rows,
                               cross_chain, ordinal, self._pool)
             self.obs.span_from(f"prefill.n{spec.n}", t0, rows=len(misses))
+            if traced:
+                c1 = self.clock()
+                for req, _, _ in misses:
+                    if req.trace_id:
+                        self.tracer.span_from(
+                            req.trace_id, f"prefill.n{spec.n}", c0, c1,
+                            rows=len(misses))
             self.stats.prefill_calls += 1
             if self._prefix is not None:
                 # publish the fresh chains — ownership moves to the cache
@@ -1286,9 +1344,17 @@ class ServeEngine:
                 sm[spec.n:] = True
                 smask[j] = sm
             t0 = time.perf_counter()
+            traced = any(req.trace_id for req, _, _ in hits)
+            c0 = self.clock() if traced else 0.0
             self._pool = self._attach_prog(
                 self._pool, ids, limits, self_rows, cross_rows, smask)
             self.obs.span_from("prefill.attach", t0, rows=len(hits))
+            if traced:
+                c1 = self.clock()
+                for req, _, _ in hits:
+                    if req.trace_id:
+                        self.tracer.span_from(req.trace_id, "prefill.attach",
+                                              c0, c1, rows=len(hits))
         self._mark_admitted(chunk, slot_ids, plans)
 
     def _mark_admitted(self, chunk: List[Request], slot_ids: List[int],
@@ -1301,8 +1367,14 @@ class ServeEngine:
             req.admit_tick = self._tick_no
             self._slots[s] = req
             self._slot_meta[s] = plans[j] if plans else None
+            hit = bool(plans and plans[j].hit)
             self.obs.emit("req.admit", id=req.id, slot=s, bucket=req.bucket,
-                          hit=bool(plans and plans[j].hit))
+                          hit=hit, **_tf(req))
+            if req.trace_id:
+                self.tracer.span_from(req.trace_id, "queue_wait",
+                                      req.submit_t, now)
+                self.tracer.event(req.trace_id, "admit", t=now, slot=s,
+                                  bucket=req.bucket, hit=hit)
 
     def _rebuild_and_resubmit(self, exc: BaseException) -> None:
         """Self-healing after a device fault escaped the decode dispatch:
@@ -1365,6 +1437,9 @@ class ServeEngine:
                           f"{type(exc).__name__}: {exc}", now=now)
             else:
                 survivors.append(req)
+                if req.trace_id:
+                    self.tracer.event(req.trace_id, "rebuild_requeue", t=now,
+                                      attempt=req.attempts)
         self._queue.extendleft(reversed(survivors))  # FIFO order preserved
 
     # ---------------- conveniences ----------------

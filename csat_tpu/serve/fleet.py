@@ -66,7 +66,7 @@ import time
 from typing import Any, Callable, Dict, List, Optional, Sequence
 
 from csat_tpu.configs import Config
-from csat_tpu.obs import EventRecorder
+from csat_tpu.obs import EventRecorder, Tracer
 from csat_tpu.obs.metrics import MetricsRegistry, merge_histograms
 from csat_tpu.serve.engine import Request, RequestStatus, ServeEngine
 from csat_tpu.serve.router import DRAINING, HEALTHY, SICK, Router
@@ -108,6 +108,8 @@ class _PendingSubmit:
     attempts: int = 0
     priority: int = 0            # tenant tier, re-submitted verbatim
     backoff_s: float = 0.0       # total backoff this request has served
+    trace_id: str = ""           # fleet-minted request trace — every retry
+    #                              attempt lands on this SAME trace
 
 
 @dataclasses.dataclass
@@ -142,6 +144,12 @@ class Fleet:
         self.log = log
         self.router = Router()
         self.obs = EventRecorder(capacity=cfg.obs_events, component="fleet")
+        # ONE tracer shared by the fleet and every replica engine
+        # (_make_replica swaps it in): a trace minted at fleet submit
+        # follows the request across routing, retirement, backoff and
+        # resubmission — replica boundaries never split a trace
+        self.tracer = Tracer(capacity=cfg.obs_traces,
+                             slowest=cfg.obs_trace_slowest, component="fleet")
         pm = cfg.obs_postmortem_dir
         self._postmortem_dir = (
             os.path.join(cfg.output_dir, "postmortem") if pm == "auto" else pm)
@@ -238,9 +246,12 @@ class Fleet:
         self._next_id += 1
         now = self.clock()
         self._m_submitted.inc()
+        # mint the request trace HERE, before any outcome is possible, so
+        # fleet-level rejections and routed requests alike have one
+        tid = self.tracer.begin(None, t=now, id=fid, priority=priority)
         healthy = [r for r in self.replicas if r.health == HEALTHY]
         if not healthy:
-            self._reject(fid, now, "no healthy replicas")
+            self._reject(fid, now, "no healthy replicas", trace_id=tid)
             return fid
 
         # fleet-wide admission control over the healthy queues
@@ -248,7 +259,8 @@ class Fleet:
             self.cfg.serve_max_queue * len(healthy))
         if bound and sum(r.engine.queue_depth for r in healthy) >= bound:
             if self.cfg.serve_queue_policy == "reject":
-                self._reject(fid, now, f"fleet queue full ({bound})")
+                self._reject(fid, now, f"fleet queue full ({bound})",
+                             trace_id=tid)
                 return fid
             target = self.router.shed_target(self.replicas)
             if target is not None:
@@ -260,11 +272,17 @@ class Fleet:
                                   replica=target.index, engine_id=shed.id)
 
         rep = self.router.pick(self.replicas)
+        if tid:
+            # router placement decision as a span on the request's trace:
+            # which replica won and against how much competition
+            self.tracer.event(tid, "route", t=now, replica=rep.index,
+                              **self.router.placement(rep, self.replicas))
         eid = rep.engine.submit(
             sample, max_new_tokens=max_new_tokens, deadline_s=deadline_s,
-            priority=priority)
+            priority=priority, trace_id=tid)
         self._routes[fid] = (rep.index, eid)
-        self.obs.emit("fleet.route", id=fid, replica=rep.index, engine_id=eid)
+        self.obs.emit("fleet.route", id=fid, replica=rep.index, engine_id=eid,
+                      **({"trace": tid} if tid else {}))
         if rep.engine.poll(eid) is None:
             # non-terminal: retain the submit args so a replica retirement
             # can move the request (terminal-at-submit outcomes stand)
@@ -273,7 +291,7 @@ class Fleet:
             self._pending[fid] = _PendingSubmit(
                 sample=sample, max_new_tokens=max_new_tokens,
                 deadline_t=(now + ddl) if ddl and ddl > 0 else None,
-                priority=priority)
+                priority=priority, trace_id=tid)
         self._update_gauges()
         return fid
 
@@ -431,6 +449,9 @@ class Fleet:
             clock=self.clock, sample_seed=self._sample_seed,
             watchdog_on_timeout=on_timeout, warmstart=self.warmstart,
             log=(lambda m, k=k: self.log(f"[replica{k}] {m}")))
+        # replicas record spans into the FLEET's trace store: a trace
+        # outlives the replica that served its first attempt
+        rep.engine.tracer = self.tracer
         if self._spawn_kills > 0:
             # chaos kill_during_spawn: the replica dies after bring-up but
             # before promotion — stop its watchdog thread and fail the
@@ -650,15 +671,21 @@ class Fleet:
                 return True
         return False
 
-    def _reject(self, fid: int, now: float, why: str) -> None:
+    def _reject(self, fid: int, now: float, why: str,
+                trace_id: str = "") -> None:
         req = Request(id=fid, sample=None,
                       limit=self.cfg.max_tgt_len - 1, submit_t=now)
         req.status = RequestStatus.REJECTED
         req.error = why
         req.done_t = now
+        req.trace_id = trace_id
         self._results[fid] = req
         self._m_rejected.inc()
-        self.obs.emit("fleet.reject", id=fid, error=why)
+        self.obs.emit("fleet.reject", id=fid, error=why,
+                      **({"trace": trace_id} if trace_id else {}))
+        if trace_id:
+            self.tracer.finish(trace_id, RequestStatus.REJECTED, t=now,
+                               id=fid, error=why)
 
     def _retire_replica(self, rep: Replica, reason: str) -> None:
         """SICK transition: shed the replica's work, close its engine
@@ -704,7 +731,17 @@ class Fleet:
                 fid=fid, due_t=now + backoff, from_replica=rep.index)
             self.obs.emit("fleet.backoff", id=fid, attempts=entry.attempts,
                           backoff_s=round(backoff, 4),
-                          from_replica=rep.index)
+                          from_replica=rep.index,
+                          **({"trace": entry.trace_id}
+                             if entry.trace_id else {}))
+            if entry.trace_id:
+                # pull the trace back from its provisional SHED terminal
+                # (the engine funnel ran during shed_all above): the retry
+                # is attempt N+1 of the SAME request story
+                self.tracer.reopen(entry.trace_id,
+                                   attempt=entry.attempts + 1, t=now,
+                                   from_replica=rep.index, reason=reason,
+                                   backoff_s=round(backoff, 4))
         self._update_gauges()
 
     def _backoff_s(self, fid: int, attempts: int) -> float:
@@ -749,16 +786,25 @@ class Fleet:
                 continue  # nowhere to go: the SHED stands
             ddl = (entry.deadline_t - now
                    if entry.deadline_t is not None else 0)
+            if entry.trace_id:
+                self.tracer.event(entry.trace_id, "resubmit", t=now,
+                                  replica=target.index,
+                                  from_replica=item.from_replica,
+                                  **self.router.placement(
+                                      target, self.replicas))
             eid2 = target.engine.submit(
                 entry.sample, max_new_tokens=entry.max_new_tokens,
-                deadline_s=ddl, priority=entry.priority)
+                deadline_s=ddl, priority=entry.priority,
+                trace_id=entry.trace_id or None)
             self._routes[fid] = (target.index, eid2)
             self.resubmissions += 1
             self._m_resubmitted.inc()
             self.obs.emit("fleet.resubmit", id=fid, replica=target.index,
                           engine_id=eid2, from_replica=item.from_replica,
                           attempts=entry.attempts,
-                          backoff_s=round(entry.backoff_s, 4))
+                          backoff_s=round(entry.backoff_s, 4),
+                          **({"trace": entry.trace_id}
+                             if entry.trace_id else {}))
 
     def _update_gauges(self) -> None:
         self._m_healthy.set(len(self.healthy_replicas))

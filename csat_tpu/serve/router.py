@@ -20,7 +20,7 @@ Health states form a one-way ladder per replica:
 
 from __future__ import annotations
 
-from typing import Optional, Sequence
+from typing import Dict, Optional, Sequence
 
 __all__ = ["HEALTHY", "SICK", "DRAINING", "Router"]
 
@@ -41,6 +41,15 @@ class Router:
     @staticmethod
     def load(replica) -> int:
         return replica.engine.queue_depth + replica.engine.occupancy
+
+    @staticmethod
+    def placement(pick, replicas: Sequence) -> Dict[str, int]:
+        """Decision context for the request trace's ``route`` span: the
+        chosen replica's load and how many healthy candidates it beat —
+        enough to reconstruct WHY the router placed a request where it
+        did without replaying the whole fleet state."""
+        return {"load": Router.load(pick),
+                "healthy": sum(1 for r in replicas if r.health == HEALTHY)}
 
     def pick(self, replicas: Sequence) -> Optional[object]:
         """The HEALTHY replica new work goes to; None when none remain."""

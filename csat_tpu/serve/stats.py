@@ -194,6 +194,11 @@ class ServeStats:
         # reads class 0 (gold) so brownout-capped low tiers cannot mask an
         # SLO breach on the tier that matters
         self.latency_by_class: Dict[int, Deque[float]] = {}
+        # per-class registry histograms (serve_class<p>_latency_seconds),
+        # created lazily on the first request of each class: unlike the
+        # deque windows these MERGE across replicas and are what the SLO
+        # engine's per-class latency objectives read (obs/slo.py)
+        self._class_hists: Dict[int, object] = {}
         self.first_done_t: Optional[float] = None
         self.last_done_t: Optional[float] = None
         self.started_t: Optional[float] = None
@@ -227,18 +232,31 @@ class ServeStats:
         self._page_samples += 1
 
     def record_request(self, submit_t: float, admit_t: float, done_t: float,
-                       n_tokens: int, priority: int = 0) -> None:
+                       n_tokens: int, priority: int = 0,
+                       trace_id: str = "") -> None:
         self.retired += 1
         self.gen_tokens += int(n_tokens)
         wait = admit_t - submit_t
         latency = done_t - submit_t
         self.wait_s.append(wait)
         self.latency_s.append(latency)
-        self.wait_hist.observe(wait)
-        self.latency_hist.observe(latency)
+        # the trace id rides the histograms as a per-bucket exemplar
+        # (newest wins): "p95 regressed" jumps straight to a trace
+        ex = trace_id or None
+        self.wait_hist.observe(wait, exemplar=ex)
+        self.latency_hist.observe(latency, exemplar=ex)
+        p = int(priority)
         cls = self.latency_by_class.setdefault(
-            int(priority), deque(maxlen=LATENCY_WINDOW))
+            p, deque(maxlen=LATENCY_WINDOW))
         cls.append(latency)
+        h = self._class_hists.get(p)
+        if h is None:
+            h = self.registry.histogram(
+                f"serve_class{p}_latency_seconds",
+                f"OK-request latency, priority class {p}",
+                buckets=_LATENCY_BUCKETS)
+            self._class_hists[p] = h
+        h.observe(latency, exemplar=ex)
         if self.first_done_t is None:
             self.first_done_t = done_t
         self.last_done_t = done_t

@@ -494,6 +494,33 @@ class TestStaticChaosBoundary:
                     f"FaultInjector ctor kwarg")
 
 
+class TestStaticObsBoundary:
+    """Observability-layer boundary (ISSUE 14 satellite): the request
+    tracer and the SLO burn-rate engine read the serve stack strictly
+    through public surfaces — ``ServeEngine``/``Fleet`` call INTO the
+    tracer, and the SLO engine consumes registries via
+    ``MetricsRegistry.get``.  Any ``obj._name`` attribute access on a
+    non-``self`` object in ``obs/rtrace.py`` or ``obs/slo.py`` is a
+    reach-through violation."""
+
+    ROOT = pathlib.Path(__file__).resolve().parent.parent
+    FILES = ("csat_tpu/obs/rtrace.py", "csat_tpu/obs/slo.py")
+
+    def test_no_private_attribute_reach_through(self):
+        offenders = []
+        for rel in self.FILES:
+            path = self.ROOT / rel
+            tree = ast.parse(path.read_text(), filename=str(path))
+            for node in ast.walk(tree):
+                if (isinstance(node, ast.Attribute)
+                        and node.attr.startswith("_")
+                        and not node.attr.startswith("__")
+                        and not (isinstance(node.value, ast.Name)
+                                 and node.value.id == "self")):
+                    offenders.append(f"{rel}:{node.lineno} .{node.attr}")
+        assert not offenders, offenders
+
+
 @pytest.mark.slow
 def test_model_backend_pallas_matches_xla_forward():
     """Full CSATrans forward with backend=pallas == backend=xla (same rngs)."""

@@ -1,0 +1,443 @@
+"""Request-scoped tracing + SLO burn rates (ISSUE 14 tentpole).
+
+Pins the four contracts of the tracing/SLO layer:
+
+* **tracer unit** — lifecycle (begin → spans → finish), dump/load
+  roundtrip, true no-op when disabled, bounded memory everywhere (ring,
+  span cap, active table, slow set survives eviction), and reopen
+  linking a fleet retry as attempt N+1 of the SAME trace;
+* **exemplars** — latency histograms keep the newest trace id per
+  bucket; they ride the JSONL snapshot (only when present), merge
+  newest-wins across replicas, and never change the byte-stable
+  Prometheus exposition;
+* **SLO engine** — multi-window burn rates from the existing registry
+  counters, alert-on-both-windows / clear-on-either transitions, and
+  ``objectives_from_config`` knob wiring;
+* **trace continuity (the acceptance drill)** — an engine OK request
+  reads submit → queue_wait → admit → prefill → decode → terminal;
+  brownout-capped and shed requests each end with exactly ONE
+  terminated trace; a request resubmitted across replica retirement is
+  ONE trace with linked attempt-numbered spans (route → retry →
+  resubmit → terminal); warm and cold replica spawns both adopt the
+  fleet's tracer so traces outlive the replica that served attempt 1.
+"""
+
+import json
+import shutil
+
+import numpy as np
+import pytest
+
+from csat_tpu.data.toy import random_request_sample
+from csat_tpu.obs.metrics import Histogram, MetricsRegistry, merge_histograms
+from csat_tpu.obs.rtrace import (
+    MAX_SPANS_PER_TRACE,
+    Tracer,
+    load_traces,
+)
+from csat_tpu.obs.slo import Objective, SLOEngine, objectives_from_config
+from csat_tpu.resilience import FaultEvent, FaultPlan
+from csat_tpu.serve import Fleet, RequestStatus, ServeEngine, collate_requests
+
+SRC_V, TGT_V, TRIP_V = 200, 300, 50
+
+
+# ---------------------------------------------------------------------------
+# tracer unit
+# ---------------------------------------------------------------------------
+
+
+def test_tracer_lifecycle_and_dump_roundtrip(tmp_path):
+    tr = Tracer(capacity=8, slowest=4, component="serve")
+    tid = tr.begin(None, t=1.0, id=7, priority=1)
+    assert tid and tid in tr.active
+    # begin is idempotent on an active id (fleet mints → engine adopts)
+    assert tr.begin(tid, t=1.5) == tid and tr.minted == 1
+    tr.event(tid, "admit", t=2.0, slot=0)
+    tr.span_from(tid, "decode", 2.0, 3.5, tokens=9)
+    tr.finish(tid, RequestStatus.OK, t=3.5)
+    assert tid not in tr.active and tr.finished_count(tid) == 1
+    rec = tr.recent(1)[0]
+    assert rec.status == RequestStatus.OK and rec.dur == pytest.approx(2.5)
+    names = [s.name for s in rec.spans]
+    assert names == ["submit", "admit", "decode", "terminal"]
+    assert rec.spans[-1].fields["status"] == RequestStatus.OK
+    # late spans / double finish on a retired id are ignored, not errors
+    tr.event(tid, "late", t=9.0)
+    tr.finish(tid, RequestStatus.FAILED, t=9.0)
+    assert tr.finished_count(tid) == 1 and tr.completed == 1
+
+    path = tr.dump(str(tmp_path / "traces.jsonl"))
+    with open(path, encoding="utf-8") as f:
+        meta = json.loads(f.readline())["meta"]
+    assert meta["component"] == "serve" and meta["traces_completed"] == 1
+    loaded = load_traces(path)
+    assert len(loaded) == 1 and loaded[0]["trace_id"] == tid
+    assert [s["name"] for s in loaded[0]["spans"]] == names
+
+
+def test_disabled_tracer_is_a_true_noop():
+    tr = Tracer(capacity=0)
+    assert not tr.enabled
+    assert tr.begin(None, t=0.0) == ""
+    tr.event("", "x", t=0.0)
+    tr.span_from("", "x", 0.0, 1.0)
+    tr.finish("", RequestStatus.OK, t=1.0)
+    assert not tr.reopen("x", attempt=2, t=0.0)
+    assert tr.minted == 0 and tr.completed == 0
+    assert not tr.active and not tr.slowest() and not tr.recent()
+
+
+def test_bounded_memory_ring_span_cap_and_active_table():
+    tr = Tracer(capacity=4, slowest=2)
+    # the slowest trace survives eviction from the newest-4 ring
+    slow_tid = tr.begin(None, t=0.0)
+    tr.finish(slow_tid, RequestStatus.OK, t=100.0)
+    for i in range(10):
+        tid = tr.begin(None, t=float(i))
+        tr.finish(tid, RequestStatus.OK, t=float(i) + 0.1)
+    assert len(tr.finished) == 4
+    assert tr.slowest()[0].trace_id == slow_tid
+    # per-trace span cap degrades to a drop counter, never growth
+    tid = tr.begin(None, t=0.0)
+    for i in range(2 * MAX_SPANS_PER_TRACE):
+        tr.event(tid, "e", t=float(i))
+    rec = tr.active[tid]
+    assert len(rec.spans) == MAX_SPANS_PER_TRACE and rec.dropped_spans > 0
+    # a caller that begins and never finishes cannot leak the active table
+    for i in range(200):
+        tr.begin(None, t=float(i))
+    assert len(tr.active) <= max(tr.capacity * 4, 64)
+    assert tr.dropped > 0
+
+
+def test_reopen_links_retry_as_same_trace():
+    tr = Tracer(capacity=8, slowest=4)
+    tid = tr.begin(None, t=0.0)
+    # replica retirement: the engine funnel stamps a provisional SHED...
+    tr.finish(tid, RequestStatus.SHED, t=1.0)
+    assert tr.finished_count(tid) == 1
+    # ...then the fleet pulls the trace back for attempt 2
+    assert tr.reopen(tid, attempt=2, t=1.5, from_replica=1)
+    assert tid in tr.active and tr.finished_count(tid) == 0
+    tr.event(tid, "resubmit", t=2.0, replica=0)
+    tr.finish(tid, RequestStatus.OK, t=3.0)
+    assert tr.finished_count(tid) == 1, "exactly one terminated trace"
+    rec = tr.recent(1)[0]
+    assert rec.status == RequestStatus.OK and rec.attempt == 2
+    # the attempt-1 story stays visible: provisional terminal included
+    names = [(s.name, s.attempt) for s in rec.spans]
+    assert ("terminal", 1) in names and ("retry", 2) in names
+    assert ("resubmit", 2) in names and names[-1] == ("terminal", 2)
+    retry = next(s for s in rec.spans if s.name == "retry")
+    assert retry.fields["from_replica"] == 1
+    # reopening an evicted id starts a fresh record under the same id
+    assert tr.reopen("never-seen", attempt=2, t=0.0) is False
+    assert "never-seen" in tr.active
+
+
+# ---------------------------------------------------------------------------
+# exemplars
+# ---------------------------------------------------------------------------
+
+
+def test_exemplars_ride_snapshot_not_exposition():
+    reg = MetricsRegistry()
+    h = reg.histogram("lat_seconds", "t", buckets=(0.1, 1.0))
+    h.observe(0.05)
+    plain_samples = h.samples()
+    snap = reg.snapshot()
+    assert "lat_seconds_exemplars" not in snap  # lazy: nothing until traced
+    h.observe(0.5, exemplar="t-01")
+    h.observe(0.6, exemplar="t-02")  # same bucket: newest wins
+    h.observe(5.0, exemplar="t-03")  # overflow bucket keeps one too
+    snap = reg.snapshot()
+    ex = snap["lat_seconds_exemplars"]
+    assert ex["1"] == ["t-02", 0.6] and ex["+Inf"] == ["t-03", 5.0]
+    # exposition shape is exemplar-free: same sample names before/after
+    assert [s for s, _ in h.samples()] == [s for s, _ in plain_samples]
+    assert 'le="1"' in reg.prometheus() and "t-02" not in reg.prometheus()
+
+
+def test_merge_histograms_keeps_newest_exemplar_per_bucket():
+    a = Histogram("h", buckets=(1.0,))
+    b = Histogram("h", buckets=(1.0,))
+    a.observe(0.5, exemplar="old")
+    b.observe(0.6, exemplar="new")  # later observe → larger recency seq
+    a.observe(2.0, exemplar="only-a")
+    merged = merge_histograms([a, b])
+    assert merged.count == 3
+    items = dict((le, ex) for le, ex, _ in merged.exemplar_items())
+    assert items["1"] == "new" and items["+Inf"] == "only-a"
+
+
+# ---------------------------------------------------------------------------
+# SLO burn-rate engine
+# ---------------------------------------------------------------------------
+
+
+class _Recorder:
+    def __init__(self):
+        self.events = []
+
+    def emit(self, name, **fields):
+        self.events.append((name, fields))
+
+
+def test_slo_alert_fires_on_both_windows_and_clears():
+    reg = MetricsRegistry()
+    ok = reg.counter("serve_requests_ok_total")
+    shed = reg.counter("serve_requests_shed_total")
+    now = [0.0]
+    rec = _Recorder()
+    gauges = MetricsRegistry()
+    slo = SLOEngine(
+        reg, [Objective(name="availability", kind="availability",
+                        target=0.9)],
+        recorder=rec, fast_s=4.0, slow_s=12.0, burn_fast=2.0, burn_slow=1.0,
+        clock=lambda: now[0], gauges=gauges)
+    assert slo.step() == []  # single sample: no baseline, no burn
+    # a shed storm: err 1.0 over a 0.1 budget → burn 10 on both windows
+    now[0] = 1.0
+    shed.inc(10)
+    (trans,) = slo.step()
+    assert trans["state"] == "alert" and trans["objective"] == "availability"
+    assert trans["burn_fast"] >= 2.0 and trans["burn_slow"] >= 1.0
+    assert "availability" in slo.alerts and slo.fired["availability"] == 1
+    assert rec.events[0][0] == "slo.alert"
+    assert gauges.snapshot()["slo_alert_availability"] == 1
+    # steady all-good traffic: the fast window drains first and the alert
+    # clears on EITHER window dropping under threshold
+    cleared = []
+    for t in range(2, 16):
+        now[0] = float(t)
+        ok.inc(10)
+        cleared += slo.step()
+    assert cleared and cleared[-1]["state"] == "ok"
+    assert not slo.alerts and slo.fired["availability"] == 1
+    assert rec.events[-1][0] == "slo.ok"
+    assert gauges.snapshot()["slo_alert_availability"] == 0
+    # registry reset (counters restart at 0) re-anchors instead of alerting
+    reg2 = MetricsRegistry()
+    reg2.counter("serve_requests_ok_total")
+    slo.source = reg2
+    now[0] = 16.0
+    assert slo.step() == []
+
+
+def test_slo_latency_objective_reads_class_histograms():
+    reg = MetricsRegistry()
+    h = reg.histogram("serve_class1_latency_seconds", buckets=(0.5, 2.0))
+    now = [0.0]
+    slo = SLOEngine(
+        lambda: [reg],
+        [Objective(name="latency_batch", kind="latency", target=0.5,
+                   latency_s=0.5, priority=1)],
+        fast_s=2.0, slow_s=4.0, burn_fast=1.5, burn_slow=1.0,
+        clock=lambda: now[0])
+    slo.step()
+    # 1 good (≤0.5s) vs 3 slow → err 0.75 over budget 0.5 → burn 1.5
+    h.observe(0.1)
+    for _ in range(3):
+        h.observe(1.0)
+    now[0] = 1.0
+    (trans,) = slo.step()
+    assert trans["state"] == "alert"
+    fast, slow = slo.burns()["latency_batch"]
+    assert fast == pytest.approx(1.5) and slow == pytest.approx(1.5)
+
+
+def test_objectives_from_config(micro_config):
+    cfg = micro_config.replace(serve_priority_classes=3,
+                               slo_latency_s=(1.0, 8.0))
+    objs = objectives_from_config(cfg)
+    assert [o.name for o in objs] == [
+        "availability", "latency_class0", "latency_class1", "latency_class2"]
+    assert objs[0].target == cfg.slo_availability
+    # a short tuple reuses its last entry for the remaining classes
+    assert [o.latency_s for o in objs[1:]] == [1.0, 8.0, 8.0]
+    assert not objectives_from_config(
+        micro_config.replace(slo_latency_s=()))[1:]
+
+
+# ---------------------------------------------------------------------------
+# trace continuity through the serving stack (the acceptance drill)
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def trace_cfg(micro_config):
+    """Deterministic micro config on the bit-identity paths with 2 slots
+    and a zero rebuild cap (one injected fault retires a replica)."""
+    return micro_config.replace(
+        full_att=True, dropout=0.0, attention_dropout=0.0,
+        cse_empty_rows="zero", serve_slots=2, bucket_src_lens=(48,),
+        serve_max_rebuilds=0, serve_priority_classes=3,
+    )
+
+
+@pytest.fixture(scope="module")
+def stack(trace_cfg):
+    from csat_tpu.train.state import (
+        create_train_state,
+        default_optimizer,
+        make_model,
+    )
+
+    cfg = trace_cfg
+    model = make_model(cfg, SRC_V, TGT_V, TRIP_V)
+    warm = collate_requests(
+        [random_request_sample(cfg, SRC_V, TRIP_V, 8, seed=0)],
+        cfg.max_src_len, 1, cfg, tgt_width=cfg.max_tgt_len - 1)
+    params = create_train_state(
+        model, default_optimizer(cfg), warm, seed=0).params
+    return cfg, model, params
+
+
+def _requests(cfg, n, seed=0, lo=5):
+    rng = np.random.default_rng(seed)
+    return [
+        random_request_sample(cfg, SRC_V, TRIP_V, int(ln),
+                              seed=1000 * seed + i)
+        for i, ln in enumerate(rng.integers(lo, cfg.max_src_len, n))
+    ]
+
+
+def test_engine_ok_request_trace_and_exemplars(stack):
+    """Every OK request reads submit → queue_wait → admit → prefill →
+    decode → terminal, and its trace id lands as a latency exemplar."""
+    cfg, model, params = stack
+    eng = ServeEngine(model, params, cfg, sample_seed=0)
+    reqs = eng.generate(_requests(cfg, 4, seed=11))
+    assert all(r.status == RequestStatus.OK for r in reqs)
+    tids = {r.trace_id for r in reqs}
+    assert len(tids) == 4 and all(tids)
+    for req in reqs:
+        assert eng.tracer.finished_count(req.trace_id) == 1
+        rec = next(r for r in eng.tracer.finished
+                   if r.trace_id == req.trace_id)
+        names = [s.name for s in rec.spans]
+        assert names[0] == "submit" and names[-1] == "terminal"
+        assert "queue_wait" in names and "admit" in names
+        assert any(n.startswith("prefill.") for n in names)
+        decode = next(s for s in rec.spans if s.name == "decode")
+        assert decode.dur >= 0 and rec.dur > 0
+        assert rec.spans[-1].fields["status"] == RequestStatus.OK
+    # the newest trace id per latency bucket rides the registry snapshot
+    snap = eng.stats.registry.snapshot()
+    ex = snap.get("serve_request_latency_seconds_exemplars")
+    assert ex and all(eid in tids for eid, _ in ex.values())
+    eng.close()
+
+
+def test_brownout_and_shed_each_terminate_exactly_once(stack):
+    """Pressure paths: a brownout-capped request carries the brownout
+    span and still ends OK; a shed request ends SHED — each with exactly
+    one terminated trace."""
+    cfg, model, params = stack
+    eng = ServeEngine(model, params, cfg, sample_seed=0)
+    eng.cfg = cfg.replace(serve_max_queue=2, serve_queue_policy="shed_oldest",
+                          serve_brownout_queue_frac=0.5,
+                          serve_brownout_max_new_tokens=1)
+    try:
+        samples = _requests(cfg, 3, seed=12)
+        ids = [eng.submit(s, priority=1) for s in samples]
+        by_id = {r.id: r for r in (eng.poll(i) for i in ids) if r is not None}
+        results = eng.drain()
+        results.update(by_id)
+        statuses = {i: results[i].status for i in ids}
+        assert RequestStatus.SHED in statuses.values()
+        assert RequestStatus.OK in statuses.values()
+        for i in ids:
+            req = results[i]
+            assert req.trace_id
+            assert eng.tracer.finished_count(req.trace_id) == 1, i
+            rec = next(r for r in eng.tracer.finished
+                       if r.trace_id == req.trace_id)
+            assert rec.status == req.status
+            if req.browned:
+                assert any(s.name == "brownout" for s in rec.spans)
+                assert req.status == RequestStatus.OK
+    finally:
+        eng.cfg = cfg
+        eng.close()
+
+
+def test_fleet_retirement_resubmission_is_one_trace(stack):
+    """The acceptance drill: a request that survives replica retirement
+    reads as ONE trace — route → (provisional SHED) → retry → resubmit →
+    terminal — with attempt-numbered spans and one terminal record."""
+    cfg, model, params = stack
+    fleet = Fleet(model, params, cfg, replicas=2, sample_seed=0)
+    samples = _requests(cfg, 10, seed=13)
+    ids = [fleet.submit(s) for s in samples]
+    before = dict(fleet.routes)
+    fleet.tick()
+    FaultPlan((FaultEvent("retire_replica", at=0, replica=1),)).apply(fleet)
+    results = fleet.drain()
+    assert fleet.resubmissions > 0
+
+    # every submitted request ended with exactly one terminated trace
+    for fid in ids:
+        tid = results[fid].trace_id
+        assert tid and fleet.tracer.finished_count(tid) == 1, fid
+
+    moved = [fid for fid, ri in before.items()
+             if ri == 1 and fleet.routes.get(fid) == 0
+             and results[fid].status == RequestStatus.OK]
+    assert moved, "drill must move queued work to the survivor"
+    for fid in moved:
+        rec = next(r for r in fleet.tracer.finished
+                   if r.trace_id == results[fid].trace_id)
+        assert rec.status == RequestStatus.OK and rec.attempt >= 2
+        names = [s.name for s in rec.spans]
+        assert names[0] == "submit" and names[-1] == "terminal"
+        for linked in ("route", "retry", "resubmit"):
+            assert linked in names, (fid, names)
+        # attempt 1's provisional SHED terminal stays in the story
+        terms = [s for s in rec.spans if s.name == "terminal"]
+        assert terms[0].attempt == 1
+        assert terms[0].fields["status"] == RequestStatus.SHED
+        assert terms[-1].attempt >= 2
+        assert terms[-1].fields["status"] == RequestStatus.OK
+        retry = next(s for s in rec.spans if s.name == "retry")
+        assert retry.fields["from_replica"] == 1
+        assert retry.fields["backoff_s"] > 0 and retry.attempt >= 2
+        resub = next(s for s in rec.spans if s.name == "resubmit")
+        assert resub.fields["replica"] == 0
+        assert resub.fields["from_replica"] == 1
+    fleet.close()
+
+
+def test_warm_and_cold_spawns_adopt_the_fleet_tracer(stack, tmp_path):
+    """Replica replacement keeps trace continuity: warm-started and
+    cold-compiled spawns both record into the FLEET's trace store, and a
+    request served by a replacement still terminates exactly once."""
+    cfg0, model, params = stack
+    cfg = cfg0.replace(serve_warmstart=True,
+                       serve_warmstart_dir=str(tmp_path / "ws"))
+    fleet = Fleet(model, params, cfg, replicas=1, sample_seed=0)
+    assert fleet.replicas[0].engine.tracer is fleet.tracer
+
+    rep_warm = fleet.add_replica()  # warm: replica 0 seeded the store
+    assert rep_warm is not None and rep_warm.engine.tracer is fleet.tracer
+    assert int(rep_warm.engine.stats.warmstart_hits) > 0
+
+    # replacement store lost on disk: the next spawn recreates an empty
+    # store and takes the cold compile path end to end
+    fleet.warmstart = None
+    shutil.rmtree(str(tmp_path / "ws"))
+    rep_cold = fleet.add_replica()
+    assert rep_cold is not None and rep_cold.engine.tracer is fleet.tracer
+    assert int(rep_cold.engine.stats.warmstart_hits) == 0
+
+    ids = [fleet.submit(s) for s in _requests(cfg, 6, seed=14)]
+    results = fleet.drain()
+    assert {fleet.routes[fid] for fid in ids} == {0, 1, 2}, \
+        "JSQ must exercise original, warm and cold replicas"
+    for fid in ids:
+        req = results[fid]
+        assert req.status == RequestStatus.OK
+        assert fleet.tracer.finished_count(req.trace_id) == 1
+    assert fleet.tracer.summary()["traces_completed"] == len(ids)
+    fleet.close()

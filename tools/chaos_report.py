@@ -29,12 +29,13 @@ import sys
 from typing import List, Tuple
 
 # event-name prefixes that make the condensed timeline: injected faults,
-# the degradation ladder acting, the invariant monitor's verdicts, and
-# the elastic-fleet lifecycle (spawn/heal — ISSUE 13)
+# the degradation ladder acting, the invariant monitor's verdicts, the
+# elastic-fleet lifecycle (spawn/heal — ISSUE 13), and SLO burn-rate
+# alert transitions (ISSUE 14)
 TIMELINE_PREFIXES = (
     "fault.", "invariant.", "req.brownout", "fleet.shed_oldest",
     "fleet.retire", "fleet.resubmit", "fleet.backoff", "fleet.draining",
-    "fleet.spawn", "autoscale.",
+    "fleet.spawn", "autoscale.", "slo.",
 )
 
 
@@ -80,6 +81,10 @@ def header_lines(meta: dict) -> List[str]:
                 for e in events))
         except (ValueError, KeyError):
             pass
+    slo = meta.get("slo_alerts") or {}
+    if slo:
+        out.append("  slo alerts: " + "  ".join(
+            f"{k}={v}" for k, v in sorted(slo.items())))
     verdict = "CLEAN" if not meta.get("violations") else "VIOLATED"
     out.append(f"  verdict: {verdict}")
     return out

@@ -12,7 +12,10 @@ Reads any combination of:
   counts found in the event stream;
 * the **perf ledger** (``--history``, ``csat_tpu/obs/perfdb.py``) — the
   bench trajectory: one row per run with raw and calibration-normalized
-  headline, box fingerprint and degradation flags (ISSUE 10).
+  headline, box fingerprint and degradation flags (ISSUE 10);
+* a **request-trace dump** (``--traces``, ``csat_tpu/obs/rtrace.py``) —
+  the slowest-N request traces as span trees with per-span durations and
+  linked attempt numbers (ISSUE 14).
 
 Usage::
 
@@ -185,6 +188,43 @@ def fleet_table(snaps: List[dict]) -> str:
         ("replica", "health", *(c for c, _ in _FLEET_COLS), "lat_mean_ms"))
 
 
+def trace_lines(path: str, slowest: int = 5) -> List[str]:
+    """The slowest-N request traces from a ``Tracer.dump`` JSONL artifact
+    (ISSUE 14) as indented span trees — one header row per trace (id,
+    status, end-to-end duration, attempts), then its spans in time order
+    with per-span durations and extra fields."""
+    from csat_tpu.obs.rtrace import load_traces
+
+    traces = load_traces(path)
+    done = [t for t in traces if t.get("status")]
+    done.sort(key=lambda t: -float(t.get("dur", 0.0)))
+    shown = done[:slowest] if slowest else done
+    out = [f"== slowest traces ({len(shown)} of {len(traces)} in "
+           f"{path}) =="]
+    if not shown:
+        return out + ["  (no finished traces in dump)"]
+    for t in shown:
+        out.append(
+            f"  {t.get('trace_id', '?')}  status={t.get('status', '?')}  "
+            f"dur={float(t.get('dur', 0.0)) * 1e3:.1f}ms  "
+            f"attempts={t.get('attempt', 1)}")
+        t0 = float(t.get("t0", 0.0))
+        rows = []
+        for sp in t.get("spans", ()):
+            extra = {k: v for k, v in sp.items()
+                     if k not in ("name", "t0", "dur", "attempt")}
+            rows.append((
+                sp.get("name", "?"),
+                sp.get("attempt", 1),
+                f"+{float(sp.get('t0', t0)) - t0:.4f}s",
+                f"{float(sp.get('dur', 0.0)) * 1e3:.2f}",
+                " ".join(f"{k}={v}" for k, v in sorted(extra.items())),
+            ))
+        table = _fmt_table(rows, ("span", "att", "start", "dur_ms", "fields"))
+        out.extend("    " + ln for ln in table.splitlines())
+    return out
+
+
 def history_table(history: List[dict]) -> str:
     """The bench trajectory as a table: one row per ledger entry, raw and
     calibration-normalized headline side by side."""
@@ -215,7 +255,9 @@ def history_table(history: List[dict]) -> str:
 def report(metrics_path: Optional[str] = None,
            events_path: Optional[str] = None,
            history_path: Optional[str] = None,
-           fleet_paths: Optional[List[str]] = None) -> str:
+           fleet_paths: Optional[List[str]] = None,
+           traces_path: Optional[str] = None,
+           slowest: int = 5) -> str:
     """The one-screen report as a string (main() prints it)."""
     sections: List[str] = []
     if fleet_paths:
@@ -276,6 +318,8 @@ def report(metrics_path: Optional[str] = None,
                 list(outcomes.items()), ("event", "count")))
         if not phases and not outcomes:
             sections.append(f"(no span or lifecycle events in {events_path})")
+    if traces_path:
+        sections.append("\n".join(trace_lines(traces_path, slowest)))
     if history_path:
         from csat_tpu.obs import perfdb
 
@@ -288,8 +332,8 @@ def report(metrics_path: Optional[str] = None,
             sections.append(f"(no ledger entries in {history_path})")
     if not sections:
         sections.append(
-            "nothing to report: pass --metrics, --events, --history "
-            "and/or --fleet")
+            "nothing to report: pass --metrics, --events, --history, "
+            "--traces and/or --fleet")
     return "\n\n".join(sections)
 
 
@@ -306,10 +350,16 @@ def main(argv: Optional[List[str]] = None) -> None:
                         "(replica<k>_-prefixed keys, `csat_tpu serve "
                         "--replicas N --metrics_file ...`) or comma-"
                         "separated per-replica metrics JSONL files")
+    p.add_argument("--traces", default="",
+                   help="request-trace dump JSONL (Tracer.dump / the "
+                        "serve CLI's --traces_file)")
+    p.add_argument("--slowest", type=int, default=5,
+                   help="how many of the slowest traces to render")
     args = p.parse_args(argv)
     fleet = [s for s in args.fleet.split(",") if s] if args.fleet else None
     print(report(args.metrics or None, args.events or None,
-                 args.history or None, fleet))
+                 args.history or None, fleet,
+                 args.traces or None, args.slowest))
 
 
 if __name__ == "__main__":

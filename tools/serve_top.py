@@ -1,0 +1,199 @@
+"""Live fleet console over the serve telemetry artifacts (ISSUE 14).
+
+``csat_tpu top`` tails the metrics JSONL the serve CLI writes
+(``--metrics_file``) and repaints one screen per refresh:
+
+* fleet header — healthy/target replicas, capacity fraction, fleet queue
+  depth and busy slots (or the single-engine equivalents);
+* per-replica table — health state, outcome counters, queue, busy slots
+  and mean latency (reuses ``tools/obs_report.py``'s fleet table);
+* KV page occupancy per replica (pages in use / usable);
+* SLO burn — per objective the fast- and slow-window burn rates and
+  whether the alert is firing (``csat_tpu/obs/slo.py`` gauges);
+* the slowest recent request traces when a trace dump
+  (``--traces_file``) is available.
+
+Everything is read from files — the console never touches a live engine,
+so it can run on another host against a tailed/copied artifact.
+
+Usage::
+
+    csat_tpu top --metrics serve_metrics.jsonl --traces serve_traces.jsonl
+    python tools/serve_top.py --metrics serve_metrics.jsonl --once
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+from typing import List, Optional, Tuple
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from tools.obs_report import (  # noqa: E402
+    _fmt_table,
+    fleet_table,
+    split_fleet_snapshot,
+    trace_lines,
+)
+
+_CLEAR = "\x1b[2J\x1b[H"
+
+
+def last_snapshot(path: str) -> Tuple[dict, int]:
+    """(last snapshot, total snapshot count) from a metrics JSONL file —
+    re-read per refresh so the console follows a file being appended to."""
+    snap: dict = {}
+    n = 0
+    try:
+        with open(path) as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    snap = json.loads(line)
+                    n += 1
+                except ValueError:
+                    continue  # torn tail line mid-append — keep previous
+    except OSError:
+        return {}, 0
+    return snap, n
+
+
+def _g(snap: dict, key: str, default=0):
+    v = snap.get(key)
+    return default if v is None else v
+
+
+def header_lines(snap: dict, n_snaps: int) -> List[str]:
+    t = snap.get("t")
+    stamp = (time.strftime("%H:%M:%S", time.localtime(t))
+             if isinstance(t, (int, float)) else "?")
+    out = [f"csat_tpu top — snapshot {n_snaps} @ {stamp}"]
+    if "fleet_capacity_frac" in snap:
+        out.append(
+            f"  fleet: {_g(snap, 'fleet_healthy_replicas')}"
+            f"/{_g(snap, 'fleet_target_replicas')} healthy  "
+            f"capacity {_g(snap, 'fleet_capacity_frac', 1.0):.2f}  "
+            f"queue {_g(snap, 'fleet_queue_depth')}  "
+            f"busy {_g(snap, 'fleet_slots_occupied')}  "
+            f"resubmissions {_g(snap, 'fleet_resubmissions_total')}  "
+            f"spawned {_g(snap, 'fleet_replicas_spawned_total')}")
+    else:
+        out.append(
+            f"  engine: queue {_g(snap, 'serve_queue_depth')}  "
+            f"busy {_g(snap, 'serve_slots_occupied')}  "
+            f"ok {_g(snap, 'serve_requests_ok_total')}  "
+            f"shed {_g(snap, 'serve_requests_shed_total')}  "
+            f"gen_tokens {_g(snap, 'serve_gen_tokens_total')}")
+    return out
+
+
+def pages_lines(snaps: List[dict]) -> List[str]:
+    """KV page occupancy per replica: in-use / usable (peak in brackets).
+    Rectangle-layout replicas (0 usable pages) are skipped."""
+    rows = []
+    for k, s in enumerate(snaps):
+        usable = _g(s, "serve_kv_pages")
+        if not usable:
+            continue
+        used = _g(s, "serve_kv_pages_in_use")
+        rows.append((f"replica{s.get('_index', k)}", used, usable,
+                     f"{used / usable:.1%}",
+                     _g(s, "serve_kv_pages_peak")))
+    if not rows:
+        return []
+    return ["== kv pages ==",
+            *_fmt_table(rows, ("replica", "in_use", "usable", "occ",
+                               "peak")).splitlines()]
+
+
+def slo_lines(snap: dict) -> List[str]:
+    """Burn-rate table + active alerts from the ``slo_*`` gauges the SLO
+    engine writes into the scrape registry."""
+    names = sorted(k[len("slo_burn_fast_"):] for k in snap
+                   if k.startswith("slo_burn_fast_"))
+    if not names:
+        return []
+    rows = []
+    firing = []
+    for name in names:
+        alert = _g(snap, f"slo_alert_{name}")
+        if alert:
+            firing.append(name)
+        rows.append((name,
+                     f"{_g(snap, f'slo_burn_fast_{name}', 0.0):.2f}",
+                     f"{_g(snap, f'slo_burn_slow_{name}', 0.0):.2f}",
+                     "FIRING" if alert else "ok"))
+    out = ["== slo burn ==",
+           *_fmt_table(rows, ("objective", "fast", "slow", "alert"))
+           .splitlines()]
+    out.append("active alerts: " + (", ".join(firing) if firing else "none"))
+    return out
+
+
+def render(metrics_path: str, traces_path: str = "",
+           slowest: int = 5) -> str:
+    """One full console frame as a string (main() repaints it)."""
+    snap, n_snaps = last_snapshot(metrics_path)
+    if not snap:
+        return f"(no snapshots yet in {metrics_path})"
+    lines = header_lines(snap, n_snaps)
+    replicas = split_fleet_snapshot(snap)
+    if replicas:
+        lines += ["", "== replicas =="]
+        lines += fleet_table(replicas).splitlines()
+        pages = pages_lines(replicas)
+        if pages:
+            lines += [""] + pages
+    else:
+        pages = pages_lines([snap])
+        if pages:
+            lines += [""] + pages
+    slo = slo_lines(snap)
+    if slo:
+        lines += [""] + slo
+    if traces_path and os.path.exists(traces_path):
+        lines += [""] + trace_lines(traces_path, slowest)
+    return "\n".join(lines)
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    p.add_argument("--metrics", required=True,
+                   help="metrics JSONL the serve CLI writes "
+                        "(--metrics_file)")
+    p.add_argument("--traces", default="",
+                   help="request-trace dump JSONL (--traces_file); "
+                        "renders the slowest recent traces")
+    p.add_argument("--interval", type=float, default=2.0,
+                   help="refresh period in seconds")
+    p.add_argument("--once", action="store_true",
+                   help="render one frame and exit (no screen clearing) — "
+                        "what the tests and scripts use")
+    p.add_argument("--slowest", type=int, default=5,
+                   help="how many of the slowest traces to show")
+    args = p.parse_args(argv)
+    try:
+        if args.once:
+            print(render(args.metrics, args.traces, args.slowest))
+            return 0
+        while True:
+            frame = render(args.metrics, args.traces, args.slowest)
+            sys.stdout.write(_CLEAR + frame + "\n")
+            sys.stdout.flush()
+            time.sleep(max(args.interval, 0.1))
+    except KeyboardInterrupt:
+        return 0
+    except BrokenPipeError:
+        # `csat_tpu top --once | head` closing the pipe is a clean exit
+        os.dup2(os.open(os.devnull, os.O_WRONLY), sys.stdout.fileno())
+        return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
