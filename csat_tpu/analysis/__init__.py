@@ -1,0 +1,30 @@
+"""csat-lint: JAX-aware static analysis over the repo's own source.
+
+The serving stack promises invariants — zero device syncs on the trace
+path, zero steady-state recompiles, layer boundaries with no private
+reach-through, structured-fallback-never-raise fault paths — that used to
+live in reviewer memory and four hand-rolled AST scans in
+``tests/test_ops.py``.  This package turns each invariant into a named,
+registered rule over the repo's ASTs:
+
+* ``csat_tpu/analysis/manifests.py`` — the declarative layer: boundary
+  file sets, hot-path roots, fault-path scopes, marker vocabularies.
+  Adding a file to a layer or a function to the hot path is a one-line
+  manifest edit, not a new test.
+* ``csat_tpu/analysis/core.py`` — findings, the rule registry, inline
+  suppressions (``# csat-lint: disable=<rule>  reason`` — every
+  suppression must carry a reason), and the runner.
+* one module per rule family: ``boundary`` / ``hotpath`` / ``compiles``
+  / ``rng`` / ``faultflow`` / ``clock``.
+
+Run it as ``csat_tpu lint`` (human or ``--format json`` output; exits
+nonzero on unsuppressed findings) or through
+:func:`csat_tpu.analysis.run_lint`.  The tier-1 test
+``tests/test_analysis.py`` keeps the live repo clean and proves every
+rule still fires on planted violations.
+"""
+
+from csat_tpu.analysis.core import (  # noqa: F401
+    Finding, LintReport, Repo, all_rules, run_lint)
+from csat_tpu.analysis.manifests import (  # noqa: F401
+    BOUNDARIES, LINT_TARGETS)

@@ -1,0 +1,185 @@
+"""The declarative layer behind every csat-lint rule.
+
+Rules are generic machinery; THIS file is where the repo's architecture
+is written down.  Each constant answers one question a reviewer used to
+answer from memory:
+
+* which files form a bounded layer (no private reach-through)?
+* which functions are the serving hot path (no device syncs, no
+  untracked compiles)?
+* which packages own fault paths (broad excepts must re-raise or emit a
+  structured event)?
+
+Growing the system edits these manifests — the rule implementations
+should almost never change.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, NamedTuple, Tuple
+
+#: Default lint targets, repo-relative (directories rglob'd for ``*.py``).
+LINT_TARGETS: Tuple[str, ...] = ("csat_tpu", "tools", "bench.py")
+
+
+# ---------------------------------------------------------------------------
+# boundary family
+# ---------------------------------------------------------------------------
+
+class Boundary(NamedTuple):
+    """One bounded layer: ``files`` compose the rest of the system
+    strictly through public surfaces — any ``obj._name`` attribute access
+    on a non-``self`` object inside them is a reach-through violation."""
+
+    name: str
+    files: Tuple[str, ...]
+    doc: str
+
+
+#: The bounded layers (supersedes the hand-rolled ``TestStaticFleet/
+#: Chaos/ObsBoundary`` scans that lived in ``tests/test_ops.py``).
+BOUNDARIES: Tuple[Boundary, ...] = (
+    Boundary(
+        "fleet",
+        ("csat_tpu/serve/fleet.py", "csat_tpu/serve/router.py",
+         "csat_tpu/serve/autoscale.py", "csat_tpu/serve/warmstart.py"),
+        "fleet/router/autoscaler/warm-start compose ServeEngine through "
+        "its public API only — resilience semantics stay inside the "
+        "engine, and the fleet survives engine-internal refactors"),
+    Boundary(
+        "chaos",
+        ("csat_tpu/serve/traffic.py", "csat_tpu/resilience/chaos.py",
+         "csat_tpu/resilience/invariants.py"),
+        "the traffic zoo, FaultPlan compiler and invariant monitors drive "
+        "the serve stack through public surfaces — an injector/engine "
+        "rename breaks loudly here, not silently at drill time"),
+    Boundary(
+        "obs",
+        ("csat_tpu/obs/rtrace.py", "csat_tpu/obs/slo.py"),
+        "the request tracer and SLO burn-rate engine are called INTO by "
+        "the serve stack and read registries via MetricsRegistry.get — "
+        "they never reach into engine/fleet internals"),
+)
+
+#: Deleted legacy Pallas kernels (PR 8's one-kernel model): importing any
+#: of these module names anywhere in ``csat_tpu/`` or ``tools/`` is a
+#: violation.
+LEGACY_KERNELS = frozenset(
+    {"sbm_pallas", "sbm_flash_pallas", "sbm_fused_pallas", "cse_pallas"})
+LEGACY_IMPORT_SCOPE: Tuple[str, ...] = ("csat_tpu/", "tools/")
+
+#: ``models/`` may not grow backend branches outside the flex-core entry
+#: point: ``select_impl(cfg.backend)`` is the single dispatch, so a
+#: ``"pallas"`` string constant outside a docstring is a violation.
+BACKEND_LITERAL_SCOPE = "csat_tpu/models/"
+BACKEND_LITERALS = frozenset({"pallas"})
+
+#: Public-ctor-kwarg check: ``FaultPlan.apply`` (and anything else in the
+#: call files) must construct :class:`FaultInjector` with keyword
+#: arguments that exist on the ctor — the hook surface is the contract.
+INJECTOR_CLASS_FILE = "csat_tpu/resilience/faults.py"
+INJECTOR_CLASS_NAME = "FaultInjector"
+INJECTOR_CALL_FILES: Tuple[str, ...] = ("csat_tpu/resilience/chaos.py",)
+
+
+# ---------------------------------------------------------------------------
+# hot-path family (host syncs + untracked compiles)
+# ---------------------------------------------------------------------------
+
+#: Modules where the invariant is ZERO device interaction of any kind
+#: (PR 14: the trace path reads host clocks only; routing decisions and
+#: burn-rate math are pure host work).  Every sync-ish construct is
+#: flagged here, including ``np.asarray``/``np.array`` and any
+#: ``jnp.*`` call at all.
+ZERO_SYNC_MODULES: Tuple[str, ...] = (
+    "csat_tpu/obs/rtrace.py", "csat_tpu/obs/slo.py",
+    "csat_tpu/serve/router.py")
+
+#: Hot-path roots per module: the per-tick / per-request entry points.
+#: The analyzer expands these through the module's own call graph
+#: (``self.x()`` and module-level calls), so a helper extracted from
+#: ``tick`` stays covered without a manifest edit.
+HOT_ROOTS: Dict[str, Tuple[str, ...]] = {
+    "csat_tpu/serve/engine.py": (
+        "ServeEngine.tick", "ServeEngine.submit", "ServeEngine.poll",
+        "ServeEngine.pop_result", "ServeEngine.drain"),
+}
+
+#: Declared cold exits from the hot graph — traversal stops here.  Each
+#: entry carries its justification; a new entry needs the same scrutiny
+#: as a suppression.
+COLD_BOUNDARIES: Dict[str, str] = {
+    "ServeEngine._aot_compile":
+        "AOT compile machinery: compiling is its purpose; every call is "
+        "warmstart-tracked and stats.record_compile-counted",
+    "ServeEngine._rebuild_and_resubmit":
+        "the declared device-fault rebuild path: recompiles are the "
+        "point, bounded by serve_rebuild_cap and counted in "
+        "stats.rebuilds",
+}
+
+#: Method/function calls that read a device value onto the host (flagged
+#: in every hot scope).
+SYNC_ATTR_CALLS = frozenset({"block_until_ready", "item"})
+SYNC_DOTTED_CALLS = frozenset({"jax.device_get"})
+#: Additionally flagged only in ZERO_SYNC_MODULES, where even building a
+#: host copy of an array is off-contract.
+TRANSFER_DOTTED_CALLS = frozenset(
+    {"np.asarray", "np.array", "numpy.asarray", "numpy.array",
+     "jnp.asarray", "jnp.array"})
+
+#: Dotted roots whose call results are treated as device arrays by the
+#: per-function inference (``x = jnp.dot(...)`` ⇒ ``float(x)`` /
+#: ``if x:`` are sync findings).
+DEVICE_ROOTS = frozenset({"jnp", "jax"})
+
+#: Compile constructors for the untracked-compile rule.
+JIT_DOTTED_CALLS = frozenset(
+    {"jax.jit", "jax.pjit", "pjit", "jax.experimental.pjit.pjit"})
+
+
+# ---------------------------------------------------------------------------
+# RNG discipline
+# ---------------------------------------------------------------------------
+
+#: ``jax.random`` functions that DERIVE fresh keys (not stream
+#: consumers) or construct keys; everything else under ``jax.random``
+#: consumes its key argument.
+RNG_DERIVERS = frozenset({"split", "fold_in", "clone"})
+RNG_MAKERS = frozenset(
+    {"key", "PRNGKey", "key_data", "wrap_key_data", "key_impl"})
+
+
+# ---------------------------------------------------------------------------
+# fault-path family
+# ---------------------------------------------------------------------------
+
+#: Packages whose broad excepts must re-raise or emit a structured
+#: event/metric (PR 13's structured-fallback-never-raise contract).
+FAULT_SCOPES: Tuple[str, ...] = ("csat_tpu/serve/", "csat_tpu/resilience/")
+
+#: Exception names considered "broad" when caught.
+BROAD_EXCEPTIONS = frozenset({"Exception", "BaseException"})
+
+#: A broad handler is structured when its body calls something whose
+#: name contains one of these markers (obs.emit, stats.record_outcome,
+#: self._note_fault, self._finish, self._retire_replica,
+#: self._rebuild_and_resubmit, counter.inc, ...) — the vocabulary of
+#: "this failure became an event, a metric, or a terminal outcome".
+EVENT_MARKERS: Tuple[str, ...] = (
+    "emit", "record", "observe", "note", "metric", "event", "postmortem",
+    "dump", "trip", "fault", "finish", "resubmit", "retire", "fail",
+    "miss", "log", "warn")
+#: Exact callee names that also qualify (too short for substring match).
+EVENT_MARKER_NAMES = frozenset({"inc"})
+
+
+# ---------------------------------------------------------------------------
+# clock discipline
+# ---------------------------------------------------------------------------
+
+#: Wall-clock reads: fine as timestamps in records, a bug the moment the
+#: value enters arithmetic or a comparison (backoff, deadlines, watchdog
+#: windows, durations) — NTP steps make intervals lie.  Use
+#: ``time.monotonic()`` / ``time.perf_counter()`` there.
+WALL_CLOCK_CALLS = frozenset({"time.time"})

@@ -1,0 +1,157 @@
+"""RNG-discipline rule: a key feeds ONE consumer.
+
+``jax.random`` functions are deterministic in their key: passing the
+same key name to two consumers (``normal``, ``uniform``, ``bernoulli``,
+…) without an intervening ``split``/``fold_in``-derived reassignment
+yields correlated streams — the classic silent-statistics bug.
+
+The checker simulates each function body in statement order, tracking
+which key names have already fed a consumer:
+
+* a consumer whose key argument is a ``split``/``fold_in`` call (a fresh
+  derivation) consumes nothing;
+* assignment rebinds: ``key, sub = jax.random.split(key)`` clears both
+  targets;
+* loop bodies are simulated twice, so a consumer drawing from a key
+  defined OUTSIDE the loop (same stream every iteration) is caught even
+  though it appears once lexically;
+* ``if``/``else`` branches are simulated on copies and unioned — two
+  exclusive branches may both consume a key, but a use after the
+  conditional still counts as reuse.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterator, List, Optional
+
+from csat_tpu.analysis.core import Finding, Repo, rule
+from csat_tpu.analysis.manifests import RNG_DERIVERS, RNG_MAKERS
+from csat_tpu.analysis.visitors import (
+    FunctionNode, assigned_names, dotted_name)
+
+RULE = "rng-reuse"
+
+
+def _random_fn(call: ast.Call) -> Optional[str]:
+    """``fold_in`` for ``jax.random.fold_in(...)`` / ``random.fold_in``
+    (the ``from jax import random`` idiom); None for anything else."""
+    d = dotted_name(call.func)
+    if d is None:
+        return None
+    parts = d.split(".")
+    if len(parts) >= 2 and parts[-2] == "random" and (
+            len(parts) == 2 or parts[-3] == "jax"):
+        return parts[-1]
+    return None
+
+
+def _key_arg(call: ast.Call) -> Optional[ast.AST]:
+    if call.args:
+        return call.args[0]
+    for kw in call.keywords:
+        if kw.arg == "key":
+            return kw.value
+    return None
+
+
+class _Sim:
+    def __init__(self, rel: str):
+        self.rel = rel
+        self.findings: List[Finding] = []
+
+    def run(self, body: List[ast.stmt], consumed: Dict[str, int]) -> None:
+        for stmt in body:
+            self._stmt(stmt, consumed)
+
+    def _stmt(self, stmt: ast.stmt, consumed: Dict[str, int]) -> None:
+        if isinstance(stmt, FunctionNode + (ast.ClassDef,)):
+            return  # nested defs are separate scopes, simulated separately
+        if isinstance(stmt, (ast.For, ast.AsyncFor, ast.While)):
+            self._expr_events(stmt, consumed, own_body=True)
+            for _ in range(2):  # a loop body runs "at least twice"
+                body_consumed = consumed
+                for s in stmt.body:
+                    self._stmt(s, body_consumed)
+            self.run(stmt.orelse, consumed)
+            return
+        if isinstance(stmt, ast.If):
+            self._expr_events(stmt, consumed, own_body=True)
+            branches = []
+            for body in (stmt.body, stmt.orelse):
+                c = dict(consumed)
+                self.run(body, c)
+                branches.append(c)
+            consumed.clear()
+            for c in branches:
+                consumed.update(c)
+            return
+        if isinstance(stmt, ast.Try):
+            for body in (stmt.body, stmt.orelse, stmt.finalbody):
+                self.run(body, consumed)
+            for h in stmt.handlers:
+                self.run(h.body, consumed)
+            return
+        if isinstance(stmt, (ast.With, ast.AsyncWith)):
+            self._expr_events(stmt, consumed, own_body=True)
+            self.run(stmt.body, consumed)
+            return
+        self._expr_events(stmt, consumed, own_body=False)
+        # rebinding clears consumption — the new value is a new stream
+        for name in assigned_names(stmt):
+            consumed.pop(name, None)
+
+    def _expr_events(self, stmt: ast.stmt, consumed: Dict[str, int],
+                     own_body: bool) -> None:
+        """Process jax.random calls in ``stmt``'s own expressions (for
+        compound statements, skip the nested body — handled by _stmt)."""
+        nodes: List[ast.AST]
+        if own_body:
+            nodes = []
+            for field_ in ("test", "iter", "target", "items"):
+                v = getattr(stmt, field_, None)
+                if isinstance(v, list):
+                    nodes.extend(v)
+                elif v is not None:
+                    nodes.append(v)
+        else:
+            nodes = [stmt]
+        for top in nodes:
+            for node in ast.walk(top):
+                if not isinstance(node, ast.Call):
+                    continue
+                fn = _random_fn(node)
+                if fn is None or fn in RNG_DERIVERS or fn in RNG_MAKERS:
+                    continue
+                key = _key_arg(node)
+                if not isinstance(key, ast.Name):
+                    continue  # fresh derivation / attribute keys: no claim
+                prev = consumed.get(key.id)
+                if prev is None:
+                    consumed[key.id] = node.lineno
+                elif prev == node.lineno:
+                    # same call site seen again: only loops revisit a
+                    # statement, so the key crosses iterations unsplit
+                    self.findings.append(Finding(
+                        self.rel, node.lineno, RULE,
+                        f"key {key.id!r} feeds the same jax.random "
+                        "consumer every loop iteration — derive a "
+                        "per-iteration key with split/fold_in"))
+                else:
+                    self.findings.append(Finding(
+                        self.rel, node.lineno, RULE,
+                        f"key {key.id!r} already fed a jax.random consumer "
+                        f"at line {prev} — split or fold_in before reuse "
+                        "(identical keys give identical streams)"))
+
+
+@rule(RULE,
+      "a PRNG key may feed only one jax.random consumer; derive fresh "
+      "keys with split/fold_in (loops are simulated twice)")
+def check_rng_reuse(repo: Repo) -> Iterator[Finding]:
+    for ctx in repo.files():
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, FunctionNode):
+                sim = _Sim(ctx.rel)
+                sim.run(node.body, {})
+                yield from sim.findings

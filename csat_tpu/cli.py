@@ -22,13 +22,16 @@ import argparse
 import json
 import sys
 
-import jax
-
 
 def main() -> None:
     # subcommand dispatch: `serve` / `summarize` / `top` go to the
-    # inference CLI (csat_tpu/serve/cli.py); everything else is the
-    # legacy train/test path
+    # inference CLI (csat_tpu/serve/cli.py), `lint` to the static
+    # analyzer (csat_tpu/analysis/); everything else is the legacy
+    # train/test path
+    if len(sys.argv) > 1 and sys.argv[1] == "lint":
+        from csat_tpu.analysis.cli import main as lint_main
+
+        raise SystemExit(lint_main(sys.argv[2:]))
     if len(sys.argv) > 1 and sys.argv[1] in ("serve", "summarize", "top"):
         from csat_tpu.serve.cli import main as serve_main
 
@@ -38,6 +41,8 @@ def main() -> None:
 
 
 def _train_main() -> None:
+    import jax
+
     p = argparse.ArgumentParser(description=__doc__)
     p.add_argument("--config", required=True, help="named variant, e.g. python, java_full_att")
     p.add_argument("--data_dir", default="", help="override the config's data_dir")

@@ -610,7 +610,7 @@ class Trainer:
         counts = np.bincount(
             assign_buckets(specs, *sample_lengths(train_ds.arrays)),
             minlength=len(specs))
-        t0 = time.time()
+        t0 = time.monotonic()
         built = 0
         ex = Batch(*(np.asarray(x) for x in example))
         for k, spec in enumerate(specs):
@@ -626,7 +626,7 @@ class Trainer:
             self.log(
                 f"bucketing: warmed {built} train-step programs for "
                 f"{int((counts > 0).sum())} occupied of {len(specs)} "
-                f"buckets in {time.time() - t0:.1f}s")
+                f"buckets in {time.monotonic() - t0:.1f}s")
         return self.program_cache.num_programs
 
     def fit(
@@ -889,7 +889,7 @@ class Trainer:
                     # analogue of the reference's torch.cuda.Event harness
                     # (csa_trans_time_memory.py:103-158; SURVEY §5)
                     jax.profiler.start_trace(os.path.join(self.output_dir, "trace"))
-                t0 = time.time()
+                t0 = time.monotonic()
                 skip = skip_iterations if epoch == start_epoch else 0
                 # loss accumulators captured WITH each rollback anchor: a
                 # narrowed replay (snapshot_every_steps) resumes the epoch
@@ -1065,8 +1065,8 @@ class Trainer:
                 mean_loss = float(loss_sum) / cnt if cnt else float("nan")
                 history["loss"].append(mean_loss)
                 loss_gauge.set(mean_loss)
-                self._scalar(epoch=epoch, loss=mean_loss, wall_s=round(time.time() - t0, 1))
-                msg = f"epoch {epoch}: loss={mean_loss:.4f} ({time.time()-t0:.1f}s)"
+                self._scalar(epoch=epoch, loss=mean_loss, wall_s=round(time.monotonic() - t0, 1))
+                msg = f"epoch {epoch}: loss={mean_loss:.4f} ({time.monotonic()-t0:.1f}s)"
                 if val_ds is not None and (epoch % cfg.val_interval == 0 or epoch == num_epochs):
                     with obs.span("train.eval"):
                         bleu = evaluate_bleu(

@@ -190,7 +190,7 @@ def main() -> None:
     rec = {"steps": [], "dims": {"b": b, "h": h, "n": n}, "variant": args.variant}
     step = 0
     epoch = 0
-    t0 = time.time()
+    t0 = time.monotonic()
     done = False
     while not done:
         for batch in iterate_batches(train_ds, cfg.batch_size, shuffle=True,
@@ -215,7 +215,7 @@ def main() -> None:
                  "adiff": round(abs(jt - tt), 6)})
             if step % 10 == 0:
                 print(f"step {step}: jax {jt:.5f} torch {tt:.5f} "
-                      f"|Δ| {abs(jt - tt):.2e} ({time.time() - t0:.0f}s)",
+                      f"|Δ| {abs(jt - tt):.2e} ({time.monotonic() - t0:.0f}s)",
                       flush=True)
             step += 1
             if step >= args.steps:
@@ -236,7 +236,7 @@ def main() -> None:
     rec["param_drift_top"] = [
         {"tensor": k, "max_rel_diff": round(v, 8)} for k, v in drift[:15]]
     rec["param_drift_median"] = float(np.median([v for _, v in drift]))
-    rec["wall_s"] = round(time.time() - t0, 1)
+    rec["wall_s"] = round(time.monotonic() - t0, 1)
     tag = f"{args.variant}_zp" if args.zero_pad else args.variant
     rec["zero_pad"] = args.zero_pad
     with open(os.path.join(args.out, f"lockstep_{tag}.json"), "w") as f:

@@ -86,7 +86,7 @@ def main() -> None:
             from tools.xla_util import cpu_child_env
 
             env = cpu_child_env()
-        t0 = time.time()
+        t0 = time.monotonic()
         try:
             proc = subprocess.run(cmd, capture_output=True, text=True,
                                   timeout=args.timeout, cwd=REPO, env=env)
@@ -103,7 +103,7 @@ def main() -> None:
                 rec = json.loads(proc.stdout.strip().splitlines()[-1])
             except (json.JSONDecodeError, IndexError):
                 rec = {"combo": combo, "error": "no JSON in child output"}
-        rec["wall_s"] = round(time.time() - t0, 1)
+        rec["wall_s"] = round(time.monotonic() - t0, 1)
         rows.append(rec)
         _append(out_path, rec)
         print(json.dumps(rec), file=sys.stderr)

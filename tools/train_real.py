@@ -171,12 +171,12 @@ def main() -> None:
 
     from csat_tpu.train.checkpoint import make_checkpoint_fn
 
-    t0 = time.time()
+    t0 = time.monotonic()
     state, history = trainer.fit(
         train_ds, val_ds, checkpoint_fn=make_checkpoint_fn(trainer.output_dir),
         resume=args.resume,
     )
-    log(f"training done in {time.time() - t0:.0f}s best_bleu={history['best_bleu']:.4f}")
+    log(f"training done in {time.monotonic() - t0:.0f}s best_bleu={history['best_bleu']:.4f}")
 
     scores = run_test(
         trainer.model, history["best_params"], test_ds, cfg, trainer.tgt_vocab,
@@ -197,7 +197,7 @@ def main() -> None:
         "val_bleu": history["val_bleu"],
         "best_val_bleu": history["best_bleu"],
         "test_scores": scores,
-        "wall_s": round(time.time() - t0, 1),
+        "wall_s": round(time.monotonic() - t0, 1),
     }
     with open(os.path.join(out_dir, "summary.json"), "w") as f:
         json.dump(summary, f, indent=1)

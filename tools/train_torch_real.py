@@ -159,12 +159,12 @@ def main() -> None:
 
     log(f"torch reference baseline: train={len(train_ds)} dev={len(dev_ds)} "
         f"test={len(test_ds)} epochs={args.epochs} params={n_param}")
-    t0 = time.time()
+    t0 = time.monotonic()
     history = {"loss": [], "val_bleu": []}
     best_bleu, best_state = -1.0, None
     model.train()
     for epoch in range(args.epochs):
-        te = time.time()
+        te = time.monotonic()
         losses = []
         for batch in iterate_batches(train_ds, cfg.batch_size, shuffle=True,
                                      seed=cfg.seed + epoch):
@@ -178,7 +178,7 @@ def main() -> None:
             losses.append(float(nll.detach()))
         mean_loss = float(np.mean(losses))
         history["loss"].append(mean_loss)
-        log(f"epoch {epoch}: loss {mean_loss:.4f} wall {time.time() - te:.0f}s")
+        log(f"epoch {epoch}: loss {mean_loss:.4f} wall {time.monotonic() - te:.0f}s")
         if (epoch + 1) % args.val_interval == 0 or epoch == args.epochs - 1:
             bleu, _, _ = evaluate(dev_ds)
             history["val_bleu"].append([epoch, bleu])
@@ -210,7 +210,7 @@ def main() -> None:
         "val_bleu": history["val_bleu"],
         "best_val_bleu": best_bleu,
         "test_scores": {"bleu": bleu, "rouge_l": rouge_l, "meteor": meteor},
-        "wall_s": round(time.time() - t0, 1),
+        "wall_s": round(time.monotonic() - t0, 1),
     }
     with open(os.path.join(args.out, "summary.json"), "w") as f:
         json.dump(summary, f, indent=1)
