@@ -59,6 +59,14 @@ BOUNDARIES: Tuple[Boundary, ...] = (
         "the request tracer and SLO burn-rate engine are called INTO by "
         "the serve stack and read registries via MetricsRegistry.get — "
         "they never reach into engine/fleet internals"),
+    Boundary(
+        "tiering",
+        ("csat_tpu/serve/tiering.py",),
+        "the tiered KV page store is host-only byte storage keyed by "
+        "content hash — it composes nothing of the engine/pool/prefix "
+        "internals (the engine drives IT through put/get/drop/clear), "
+        "so the store stays testable without a device and reusable "
+        "under any pool layout"),
 )
 
 #: Deleted legacy Pallas kernels (PR 8's one-kernel model): importing any
@@ -156,6 +164,9 @@ RNG_MAKERS = frozenset(
 
 #: Packages whose broad excepts must re-raise or emit a structured
 #: event/metric (PR 13's structured-fallback-never-raise contract).
+#: ``csat_tpu/serve/`` covers ``serve/tiering.py`` (ISSUE 16) by
+#: directory: every swallowed restore failure must surface as a
+#: ``tier.restore_miss``/``tier.spill``-style structured event.
 FAULT_SCOPES: Tuple[str, ...] = ("csat_tpu/serve/", "csat_tpu/resilience/")
 
 #: Exception names considered "broad" when caught.
@@ -169,7 +180,7 @@ BROAD_EXCEPTIONS = frozenset({"Exception", "BaseException"})
 EVENT_MARKERS: Tuple[str, ...] = (
     "emit", "record", "observe", "note", "metric", "event", "postmortem",
     "dump", "trip", "fault", "finish", "resubmit", "retire", "fail",
-    "miss", "log", "warn")
+    "miss", "spill", "log", "warn")
 #: Exact callee names that also qualify (too short for substring match).
 EVENT_MARKER_NAMES = frozenset({"inc"})
 

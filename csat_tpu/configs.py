@@ -282,6 +282,21 @@ class Config:
     # explicit warm-start store directory; "" = <cache root>/warmstart
     # (CSAT_TPU_NO_CACHE disables the store regardless)
     serve_warmstart_dir: str = ""
+    # --- tiered KV page store (ISSUE 16: serve/tiering.py) ---
+    # spill cold prefix-cache chains to host RAM (and onward to a
+    # digest-verified disk tier) instead of destroying them on eviction;
+    # a later identical admission restores them into fresh pages.
+    # Requires the paged layout and a prefix cache
+    serve_tiering: bool = False
+    # host-tier budget in KV pages (0 = unbounded); overflow demotes the
+    # LRU snapshot to the disk tier
+    serve_tier_host_pages: int = 0
+    # disk-tier budget in KV pages (0 = unbounded); overflow deletes the
+    # LRU snapshot file
+    serve_tier_disk_pages: int = 0
+    # disk-tier directory; "" = <output_dir>/kv_tiers. An unwritable
+    # directory disables the disk tier (host-only ladder), never serving
+    serve_tier_dir: str = ""
     # autoscaler band (serve/autoscale.py): heal/scale between these
     # bounds. serve_max_replicas 0 = use serve_replicas as the ceiling
     serve_min_replicas: int = 1
@@ -558,6 +573,16 @@ class Config:
         assert (self.serve_resubmit_backoff_max_s
                 >= self.serve_resubmit_backoff_s), (
             self.serve_resubmit_backoff_max_s)
+        assert self.serve_tier_host_pages >= 0, self.serve_tier_host_pages
+        assert self.serve_tier_disk_pages >= 0, self.serve_tier_disk_pages
+        if self.serve_tiering:
+            # tier keys are prefix-cache content hashes and payloads are
+            # page snapshots: tiering without both has nothing to spill
+            assert self.serve_kv_layout == "paged", (
+                "serve_tiering requires serve_kv_layout='paged'")
+            assert self.serve_prefix_cache > 0, (
+                "serve_tiering requires a prefix cache "
+                "(serve_prefix_cache > 0)")
         assert self.serve_min_replicas >= 1, self.serve_min_replicas
         assert self.serve_max_replicas >= 0, self.serve_max_replicas
         if self.serve_max_replicas:

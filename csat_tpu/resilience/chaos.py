@@ -46,6 +46,12 @@ Fault kinds (compilation targets in parentheses):
 ``kill_during_spawn`` arm the fleet's spawn-kill hook: the next ``count``
                       ``add_replica`` bring-ups die mid-spawn (fleet-level,
                       latched at apply time)
+``spill_storm``       force-spill every unreferenced prefix-cache entry to
+                      the KV tiers for ``count`` consecutive ticks
+                      (``ServeEngine.spill_all``, ISSUE 16)
+``corrupt_tier_restore``  flip payload bytes in every tiered KV snapshot
+                      (both tiers, digests kept) so later restores must
+                      fail verification and degrade to re-prefill
 ====================  =====================================================
 
 The two fleet-level kinds have no per-tick injector to compile onto — they
@@ -73,7 +79,8 @@ __all__ = ["FaultEvent", "FaultPlan", "ChaosReport", "run_chaos"]
 
 KINDS = ("nan_logits", "wedge_slot", "hang", "prefill_fail",
          "decode_fault", "reap_storm", "retire_replica",
-         "corrupt_warmstart", "kill_during_spawn")
+         "corrupt_warmstart", "kill_during_spawn",
+         "spill_storm", "corrupt_tier_restore")
 
 # kinds that act on the FLEET (warm-start store / spawn hook), not on any
 # engine's injector — latched at apply time, no per-tick schedule
@@ -125,15 +132,19 @@ class FaultPlan:
 
     @staticmethod
     def random(seed: int, n_events: int = 3, replicas: int = 1,
-               slots: int = 4) -> "FaultPlan":
+               slots: int = 4, tiered: bool = False) -> "FaultPlan":
         """A seeded random storm for the property test.  ``hang`` is
         excluded (it sleeps real wall time) and ``retire_replica`` only
         appears with >1 replica, never aimed at replica 0 — the storm must
-        leave at least one replica serving."""
+        leave at least one replica serving.  ``tiered=True`` (the target
+        serves with ``serve_tiering``) adds the two tier kinds to the
+        draw pool."""
         rng = np.random.default_rng(seed)
         kinds = ["nan_logits", "wedge_slot", "prefill_fail", "decode_fault"]
         if replicas > 1:
             kinds += ["reap_storm", "retire_replica"]
+        if tiered:
+            kinds += ["spill_storm", "corrupt_tier_restore"]
         events = []
         for _ in range(n_events):
             kind = kinds[int(rng.integers(0, len(kinds)))]
@@ -200,6 +211,8 @@ class FaultPlan:
             wedge: List[tuple] = []
             prefill: List[int] = []
             decode: set = set()
+            spill: set = set()
+            corrupt: set = set()
             hang_tick: Optional[int] = None
             hang_s = 0.0
             for e in evs:
@@ -225,13 +238,19 @@ class FaultPlan:
                 elif e.kind == "retire_replica":
                     decode.update(
                         range(t0 + e.at, t0 + e.at + RETIRE_HORIZON))
+                elif e.kind == "spill_storm":
+                    spill.update(range(t0 + e.at, t0 + e.at + e.count))
+                elif e.kind == "corrupt_tier_restore":
+                    corrupt.add(t0 + e.at)
             inj = FaultInjector(
                 serve_nan_logits=nan,
                 serve_wedge_slots=wedge,
                 serve_prefill_fail_calls=prefill,
                 serve_decode_fail_ticks=frozenset(decode),
                 serve_hang_at_tick=hang_tick,
-                hang_seconds=hang_s)
+                hang_seconds=hang_s,
+                serve_spill_storm_ticks=frozenset(spill),
+                serve_corrupt_tier_ticks=frozenset(corrupt))
             eng.fault_injector = inj
             out[k] = inj
         return out

@@ -35,7 +35,13 @@ mode is reproducible on a chosen tick:
 * **decode fault** — the decode dispatch raises on a chosen tick,
   exercising the bounded rebuild-and-resubmit path;
 * **poison sample** — :meth:`poison_sample` malforms a request payload in
-  a chosen way, exercising the submit-time quarantine.
+  a chosen way, exercising the submit-time quarantine;
+* **spill storm** — force-spill every unreferenced prefix-cache entry to
+  the KV tiers on a chosen tick (ISSUE 16), the whole-warm-set eviction
+  a page-pressure spike causes;
+* **corrupt tier restore** — flip payload bytes in every tiered KV
+  snapshot so subsequent restores must fail digest verification and
+  degrade to re-prefill.
 
 Step ordinals are global train-step attempts (0-based, counted by the
 Trainer across epochs within one ``fit`` call); batch ordinals count
@@ -78,6 +84,8 @@ class FaultInjector:
         serve_hang_at_tick: Optional[int] = None,
         serve_wedge_slots: Collection[tuple] = (),
         serve_decode_fail_ticks: Collection[int] = (),
+        serve_spill_storm_ticks: Collection[int] = (),
+        serve_corrupt_tier_ticks: Collection[int] = (),
     ) -> None:
         self.nan_loss_steps = frozenset(int(s) for s in nan_loss_steps)
         self.spike_steps = frozenset(int(s) for s in spike_steps)
@@ -100,6 +108,11 @@ class FaultInjector:
         self.serve_wedge_slots = {int(t): int(s) for t, s in serve_wedge_slots}
         self.serve_decode_fail_ticks = frozenset(
             int(t) for t in serve_decode_fail_ticks)
+        # tiered KV store faults (ISSUE 16): tick ordinals
+        self.serve_spill_storm_ticks = frozenset(
+            int(t) for t in serve_spill_storm_ticks)
+        self.serve_corrupt_tier_ticks = frozenset(
+            int(t) for t in serve_corrupt_tier_ticks)
         # optional flight recorder (csat_tpu/obs/events.py): the component
         # consuming the injector attaches its own recorder so every fired
         # fault is stamped into the SAME timeline the post-mortem dumps —
@@ -168,6 +181,25 @@ class FaultInjector:
         if self.serve_hang_at_tick is not None and tick == self.serve_hang_at_tick:
             self._note("hang_tick", tick=tick, seconds=self.hang_seconds)
             self._sleep(self.hang_seconds)
+
+    def spill_storm(self, tick: int) -> bool:
+        """Should this tick force-spill every unreferenced prefix-cache
+        entry down the tier ladder (``ServeEngine.spill_all``)?  Models a
+        page-pressure storm evicting the whole warm set at once."""
+        if tick in self.serve_spill_storm_ticks:
+            self._note("spill_storm", tick=tick)
+            return True
+        return False
+
+    def corrupt_tier(self, tick: int) -> bool:
+        """Should this tick corrupt every tiered snapshot
+        (``ServeEngine.corrupt_tiers``)?  Models bit rot / torn writes in
+        the host+disk tiers: later restores must degrade to re-prefill
+        through digest verification, never scatter garbage."""
+        if tick in self.serve_corrupt_tier_ticks:
+            self._note("corrupt_tier_restore", tick=tick)
+            return True
+        return False
 
     def maybe_fail_prefill(self, call_ordinal: int) -> None:
         """Raise on the configured prefill call ordinals — a device fault

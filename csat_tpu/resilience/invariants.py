@@ -40,7 +40,15 @@ telemetry an operator would read:
   healed every retirement instead of serving degraded forever;
 * **bit_identity** — optional: healthy-replica outputs during a
   sick-replica drill must match a fault-free reference token-for-token
-  (:meth:`InvariantMonitor.check_tokens`, used by the ``:chaos`` bench).
+  (:meth:`InvariantMonitor.check_tokens`, used by the ``:chaos`` bench);
+* **no_chain_leak** — tiered KV store (ISSUE 16): at quiescence each
+  engine's tier-chain accounting reconciles — no content hash tracked as
+  both HBM-resident and tiered, per-tier occupancy gauges equal to the
+  pages the tier indices hold
+  (:meth:`~csat_tpu.serve.engine.ServeEngine.chain_leaks` == 0);
+* **restore_bit_identity** — tiering drills: decodes served through a
+  spill→restore cycle must match a never-spilled reference
+  token-for-token (``check_tokens(..., label="restore_bit_identity")``).
 
 Violations are structured (:class:`Violation`), land in the monitor's own
 event recorder, and :meth:`InvariantMonitor.assert_clean` dumps a
@@ -266,6 +274,24 @@ class InvariantMonitor:
                     f"prefix cache's pins at quiescence",
                     component=label, pages=leaked)
 
+        # tier-ladder chain accounting reconciles at quiescence (ISSUE
+        # 16): no key tracked as both HBM-resident and tiered, occupancy
+        # gauges equal to the pages the tier indices actually hold
+        for label, eng in engines:
+            fn = getattr(eng, "chain_leaks", None)
+            if fn is None:
+                continue
+            self.checks += 1
+            if eng.occupancy:
+                continue  # not quiescent: accounting check undefined
+            bad = fn()
+            if bad:
+                self._violate(
+                    "no_chain_leak",
+                    f"{label}: {bad} tier-chain accounting errors at "
+                    f"quiescence (double-tracked or mis-counted chains)",
+                    component=label, errors=bad)
+
         # fault budgets never silently exceeded
         for label, eng in engines:
             self.checks += 1
@@ -301,7 +327,10 @@ class InvariantMonitor:
                      label: str = "bit_identity") -> None:
         """Healthy-replica bit-identity: every id in ``expected`` must have
         token-identical output in ``got`` (sick-replica drill: replicas the
-        fault never touched must be unaffected by it)."""
+        fault never touched must be unaffected by it).  ``label`` names the
+        invariant the violation is filed under — the tiering drills pass
+        ``restore_bit_identity`` so a restored-chain divergence is
+        distinguishable from a healthy-replica one."""
         import numpy as np
 
         self.checks += 1
@@ -310,7 +339,7 @@ class InvariantMonitor:
             if other is None or not np.array_equal(
                     np.asarray(toks), np.asarray(other)):
                 self._violate(
-                    "bit_identity",
+                    label,
                     f"{label}: request {rid} diverged from the fault-free "
                     f"reference", id=rid)
 

@@ -69,6 +69,22 @@ def _parser() -> argparse.ArgumentParser:
                         "jax.export'd serving programs under the "
                         "compilation-cache root so replacement replicas "
                         "skip trace+lower on bring-up")
+    p.add_argument("--tiering", action="store_true",
+                   help="tiered KV page store (serve/tiering.py): spill "
+                        "cold prefix-cache chains to host RAM / a "
+                        "digest-verified disk tier instead of destroying "
+                        "them; identical later admissions restore instead "
+                        "of re-prefilling (requires --kv_layout paged and "
+                        "a prefix cache)")
+    p.add_argument("--tier_host_pages", type=int, default=0,
+                   help="host-tier budget in KV pages; 0 = unbounded "
+                        "(overflow demotes LRU snapshots to disk)")
+    p.add_argument("--tier_disk_pages", type=int, default=0,
+                   help="disk-tier budget in KV pages; 0 = unbounded "
+                        "(overflow deletes LRU snapshot files)")
+    p.add_argument("--tier_dir", default="",
+                   help="disk-tier directory (default: "
+                        "<output_dir>/kv_tiers)")
     p.add_argument("--kv_layout", default="",
                    help="paged | rect KV-cache layout (default: config "
                         "serve_kv_layout)")
@@ -185,6 +201,14 @@ def build_engine(args):
         overrides["serve_max_replicas"] = args.max_replicas
     if getattr(args, "warmstart", False):
         overrides["serve_warmstart"] = True
+    if getattr(args, "tiering", False):
+        overrides["serve_tiering"] = True
+    if getattr(args, "tier_host_pages", 0):
+        overrides["serve_tier_host_pages"] = args.tier_host_pages
+    if getattr(args, "tier_disk_pages", 0):
+        overrides["serve_tier_disk_pages"] = args.tier_disk_pages
+    if getattr(args, "tier_dir", ""):
+        overrides["serve_tier_dir"] = args.tier_dir
     cfg = get_config(args.config, **overrides)
 
     src_vocab, tgt_vocab = load_vocab(cfg.data_dir)

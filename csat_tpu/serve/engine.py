@@ -77,6 +77,8 @@ from csat_tpu.serve.pages import (
     build_attach,
     build_paged_decode_step,
     build_release,
+    build_tier_gather,
+    build_tier_restore,
     chain_table_row,
     init_paged_pool,
     page_geometry,
@@ -91,6 +93,7 @@ from csat_tpu.serve.prefill import (
 from csat_tpu.serve.prefix import PrefixCache, sample_hash
 from csat_tpu.serve.slots import SlotPool, build_decode_step, init_pool
 from csat_tpu.serve.stats import ServeStats
+from csat_tpu.serve.tiering import TieredPageStore
 from csat_tpu.serve.warmstart import (
     WarmStartStore,
     git_rev,
@@ -183,6 +186,11 @@ class PagePlan:
     phash: Optional[bytes]  # content hash (None when the cache is off)
     hit: bool               # cross chain came from a prefix-cache hit
     shared: bool            # cross chain is cache-owned, not allocator-owned
+
+
+# _restore_plan outcome distinct from "wait" (None) and a funded PagePlan:
+# the tiered snapshot was unusable and the admission re-prefills instead
+_RESTORE_MISS = object()
 
 
 class ServeEngine:
@@ -375,6 +383,42 @@ class ServeEngine:
                 np.ones((self.num_slots, self.geo.mem_len), bool),
             ), (0,))
             self.stats.record_compile("attach", (self.num_slots,))
+        # tiered KV page store (serve/tiering.py, ISSUE 16): spill cold
+        # prefix-cache chains HBM → host RAM → digest-verified disk, and
+        # restore them on a later identical admission.  Both device
+        # programs are AOT-compiled HERE — the first spill happens under
+        # page pressure and the first restore mid-traffic, exactly where a
+        # lazy compile would stall the tick loop and trip the tripwire
+        self._tiers: Optional[TieredPageStore] = None
+        self._tier_gather_prog = None
+        self._tier_restore_prog = None
+        if self.paged and cfg.serve_tiering and self._prefix is not None:
+            root = cfg.serve_tier_dir or os.path.join(
+                cfg.output_dir, "kv_tiers")
+            self._tiers = TieredPageStore(
+                host_pages=cfg.serve_tier_host_pages,
+                disk_pages=cfg.serve_tier_disk_pages,
+                root=root, log=log, obs=self.obs)
+            layers = sorted(self._pool.pages)
+            probe = self._pool.pages[layers[0]]["k"]
+            # one snapshot is (layers, k|v, chain width, H, page, dh),
+            # zero-padded past the chain — fixed shape, one program each
+            self._tier_shape = (len(layers), 2, self.geo.cp) + tuple(
+                probe.shape[1:])
+            self._tier_dtype = np.dtype(probe.dtype)
+            fn = jax.jit(build_tier_gather())
+            self._tier_gather_prog = self._aot_compile(
+                "tier_gather", fn,
+                (self._pool, np.full((self.geo.cp,), NULL_PAGE, np.int32)),
+                ())
+            self.stats.record_compile("tier_gather", (self.geo.cp,))
+            fn = jax.jit(build_tier_restore(), donate_argnums=(0,))
+            self._tier_restore_prog = self._aot_compile(
+                "tier_restore", fn,
+                (self._pool,
+                 np.full((self.geo.cp,), self.geo.num_pages, np.int32),
+                 np.zeros(self._tier_shape, self._tier_dtype)), (0,))
+            self.stats.record_compile("tier_restore", self._tier_shape)
         self._nan_prog = None  # built lazily, fault drills only
         self._sync_page_stats()
         # init-time programs are live: stamp bring-up cost + provenance.
@@ -412,6 +456,10 @@ class ServeEngine:
         if self._watchdog is not None:
             self._watchdog.stop()
             self._watchdog = None
+        if getattr(self, "_tiers", None) is not None:
+            # drop both tiers (disk files removed): tiered snapshots are
+            # an in-lifetime optimization, not a persistence contract
+            self._tiers.clear()
         self._flush_postmortems(force=True)
         return True
 
@@ -645,6 +693,13 @@ class ServeEngine:
                 # silently freeze the device row — the host scheduler is
                 # NOT told, so only the reaper can recover the request
                 self._freeze_rows([wedge])
+            # tier chaos (ISSUE 16): a spill storm force-spills every
+            # unreferenced cache entry; a corruption fault flips payload
+            # bytes so the next restore MUST fail digest verification
+            if inj.spill_storm(tick):
+                self.spill_all()
+            if inj.corrupt_tier(tick):
+                self.corrupt_tiers()
         t0 = time.perf_counter()
         self._retire()
         self._expire_and_reap()
@@ -654,6 +709,8 @@ class ServeEngine:
         obs.span_from("tick.admit", t0)
         if self.paged:
             self.stats.note_pages(self._allocator.used_pages)
+            if self._tiers is not None:
+                self._stamp_tier_stats()
         self.stats.queue_depth = len(self._queue)
         live = sum(r is not None for r in self._slots)
         self.stats.occupancy = live
@@ -781,6 +838,57 @@ class ServeEngine:
             for plan in self._slot_meta if plan is not None)
         return self._allocator.used_pages - pinned - held
 
+    def chain_leaks(self) -> int:
+        """Tier-side chain accounting errors (the ``no_chain_leak``
+        invariant, ISSUE 16) — meaningful at quiescence, 0 when tiering is
+        off.  Counts keys double-tracked as both HBM-resident (prefix
+        cache) and tiered — spill and restore are MOVES, an entry lives in
+        exactly one place — plus the store's own audit (occupancy gauges
+        vs indexed pages, host/disk disjointness).  Allocator-side leaks
+        are :meth:`page_leaks`'s job; the two checks compose, they don't
+        overlap."""
+        if not self.paged or self._tiers is None:
+            return 0
+        bad = self._tiers.accounting_errors()
+        if self._prefix is not None:
+            bad += sum(1 for h in self._prefix.keys() if h in self._tiers)
+        return bad
+
+    def spill_all(self) -> int:
+        """Force-spill EVERY unreferenced prefix-cache entry down the tier
+        ladder — the ``spill_storm`` chaos hook, and a useful pre-scale-down
+        lever (empty the HBM cache, keep the value).  Entries with live
+        sharers are untouched.  Returns the number of chains spilled."""
+        if self._prefix is None or self._tiers is None:
+            return 0
+        pairs = self._prefix.evict_for(1 << 30)
+        self._spill_chains(pairs)
+        if pairs:
+            self.obs.emit("tier.spill_all", chains=len(pairs))
+        return len(pairs)
+
+    def corrupt_tiers(self) -> int:
+        """Flip payload bytes in every tiered snapshot (both tiers),
+        keeping the recorded digests — the ``corrupt_tier_restore`` chaos
+        hook.  Every subsequent restore of a corrupted entry must surface
+        as a structured ``tier.restore_miss`` + re-prefill, never a wrong
+        chain.  Returns the number of entries corrupted."""
+        if self._tiers is None:
+            return 0
+        return self._tiers.corrupt_entries()
+
+    def _stamp_tier_stats(self) -> None:
+        """Mirror the tier store's occupancy gauges and lifetime counters
+        onto the scrape surface (obs_report / ``csat_tpu top`` read ONLY
+        the metrics JSONL, never a live store)."""
+        t = self._tiers
+        self.stats.tier_host_pages = t.host_pages_in_use
+        self.stats.tier_disk_pages = t.disk_pages_in_use
+        self.stats.tier_spills = t.spills
+        self.stats.tier_demotions = t.demotions
+        self.stats.tier_restores = t.restores
+        self.stats.tier_restore_misses = t.restore_misses
+
     def _retry_hint(self) -> Optional[float]:
         """Structured backpressure hint for REJECTED/SHED outcomes: the
         configured base scaled by how deep the queue is relative to the
@@ -895,20 +1003,116 @@ class ServeEngine:
     def _alloc_with_evict(self, n: int) -> Optional[List[int]]:
         """Allocate ``n`` pages, evicting unreferenced prefix-cache entries
         (LRU first) under pool pressure — cache pins never starve live
-        admissions, and entries with live sharers are never touched."""
+        admissions, and entries with live sharers are never touched.  With
+        tiering on, an unfundable request triggers SPILL instead of pure
+        eviction: the evicted chains' contents move down the ladder and a
+        later identical admission restores them instead of re-prefilling."""
         chain = self._allocator.alloc(n)
         if chain is not None or self._prefix is None:
             return chain
-        for evicted in self._prefix.evict_for(n - self._allocator.free_pages):
-            self._allocator.free(evicted)
+        self._spill_chains(
+            self._prefix.evict_for(n - self._allocator.free_pages))
         return self._allocator.alloc(n)
+
+    def _spill_chains(self, pairs) -> None:
+        """Retire evicted prefix-cache ``(hash, chain)`` pairs: with tiering
+        on, snapshot each chain's page contents into the tier store FIRST
+        (gather program → host bytes → digest recorded at put), then return
+        the pages to the allocator.  Only unreferenced cache entries ever
+        reach here — ``PrefixCache`` never evicts a chain a live slot
+        references, so a spill can never tear pages out from under a
+        decode."""
+        for phash, chain in pairs:
+            if self._tiers is not None and chain:
+                row = chain_table_row(chain, self.geo.cp)
+                snap = np.asarray(self._tier_gather_prog(self._pool, row))
+                payload = np.ascontiguousarray(snap[:, :, : len(chain)])
+                self._tiers.put(phash, payload.tobytes(), {
+                    "pages": len(chain),
+                    "shape": list(payload.shape),
+                    "dtype": payload.dtype.str,
+                })
+            self._allocator.free(chain)
+        if pairs and self._tiers is not None:
+            self._stamp_tier_stats()
+
+    def _restore_plan(self, req: Request, phash: bytes,
+                      sp_need: int) -> Any:
+        """Fund an admission from a TIERED snapshot: allocate fresh chains,
+        scatter the digest-verified bytes back into the pool (restore
+        program), and re-insert the chain into the prefix cache — from here
+        the plan flows through the ordinary attach path, so a restored
+        chain is bit-identical to one that never spilled.  Returns a
+        :class:`PagePlan`, None (unfundable this tick — the snapshot stays
+        tiered and the request waits), or ``_RESTORE_MISS`` when the
+        restore failed: the store already emitted the structured
+        ``tier.restore_miss{reason}`` and the caller degrades to a normal
+        re-prefill admission."""
+        w = self._tiers.pages(phash)
+        if w <= 0 or w > self.geo.cp:
+            # index entry that cannot describe a chain of this pool's
+            # geometry (e.g. a stale disk dir from another config)
+            self._tiers.invalidate(phash, "truncated")
+            self._stamp_tier_stats()
+            return _RESTORE_MISS
+        self_chain = self._alloc_with_evict(sp_need)
+        if self_chain is None:
+            return None
+        cross_chain = self._alloc_with_evict(w)
+        if cross_chain is None:
+            self._allocator.free(self_chain)
+            return None
+        t0 = time.perf_counter()
+        payload, meta, tier = self._tiers.get(phash)
+        if payload is None:
+            # structured miss already counted/emitted by the store —
+            # refund the chains and re-prefill
+            self._allocator.free(cross_chain)
+            self._allocator.free(self_chain)
+            self._stamp_tier_stats()
+            return _RESTORE_MISS
+        want = (self._tier_shape[0], 2, w) + self._tier_shape[3:]
+        try:
+            snap = np.frombuffer(
+                payload, dtype=np.dtype(meta["dtype"])).reshape(meta["shape"])
+        except (KeyError, TypeError, ValueError):
+            snap = None
+        if snap is None or snap.shape != want:
+            # digest-intact bytes that do not decode to THIS pool's
+            # snapshot shape (geometry skew) — never scatter them
+            self._tiers.invalidate(phash, "truncated")
+            self._allocator.free(cross_chain)
+            self._allocator.free(self_chain)
+            self._stamp_tier_stats()
+            return _RESTORE_MISS
+        full = np.zeros(self._tier_shape, self._tier_dtype)
+        full[:, :, :w] = snap
+        # sentinel-padded row: padding lanes drop instead of writing page 0
+        row = np.full((self.geo.cp,), self.geo.num_pages, np.int32)
+        row[:w] = cross_chain
+        self._pool = self._tier_restore_prog(self._pool, row, full)
+        self._tiers.drop(phash)  # moved back into HBM (a re-spill re-snapshots)
+        self.stats.note_tier_restore(time.perf_counter() - t0)
+        evicted = self._prefix.insert(phash, cross_chain)
+        shared = evicted is not None
+        if evicted:
+            self._spill_chains(evicted)
+        # a restored admission IS a prefix hit: the encoder never runs
+        self.stats.prefix_hits += 1
+        self._prefix.count_hit(phash)
+        self._stamp_tier_stats()
+        return PagePlan(self_chain, cross_chain, phash, hit=True,
+                        shared=shared)
 
     def _plan_pages(self, req: Request) -> Optional[PagePlan]:
         """Fund one request's chains: self-KV sized by its ACTUAL token
         budget, cross-KV by its prefill bucket — or a prefix-cache hit,
         which shares an existing chain and needs no cross pages at all.
-        None (no state change) when the pool cannot fund it this tick; the
-        request waits at the queue head instead of wedging mid-decode."""
+        A miss that matches a TIERED snapshot restores it instead of
+        re-prefilling (``_restore_plan``); a failed restore degrades right
+        back to the miss path below.  None (no state change) when the pool
+        cannot fund it this tick; the request waits at the queue head
+        instead of wedging mid-decode."""
         spec = self.specs[req.bucket]
         sp_need = self.geo.self_pages(req.limit)
         phash = None
@@ -924,6 +1128,10 @@ class ServeEngine:
                 self._prefix.count_hit(phash)
                 return PagePlan(self_chain, list(entry.chain), phash,
                                 hit=True, shared=True)
+            if self._tiers is not None and self._tiers.has(phash):
+                plan = self._restore_plan(req, phash, sp_need)
+                if plan is not _RESTORE_MISS:
+                    return plan
         self_chain = self._alloc_with_evict(sp_need)
         if self_chain is None:
             return None
@@ -1320,8 +1528,7 @@ class ServeEngine:
                     evicted = self._prefix.insert(plan.phash, plan.cross_chain)
                     if evicted is not None:
                         plan.shared = True
-                        for chain in evicted:
-                            self._allocator.free(chain)
+                        self._spill_chains(evicted)
         if hits:
             s_att = self.num_slots
             ids = np.full((s_att,), self.num_slots, np.int32)
@@ -1418,6 +1625,13 @@ class ServeEngine:
             self._allocator = PageAllocator(self.geo.num_pages)
             if self._prefix is not None:
                 self._prefix.clear()
+            if self._tiers is not None:
+                # allocator + prefix + tiers reset in the same breath:
+                # snapshots gathered from the faulting device are not
+                # trusted across a rebuild (zero leaked chains, pinned by
+                # tests/test_tiering.py)
+                self._tiers.clear()
+                self._stamp_tier_stats()
             self._pool = init_paged_pool(
                 self.model, {"params": self.params}, self.num_slots, self.geo)
         else:

@@ -60,6 +60,8 @@ __all__ = [
     "build_paged_decode_step",
     "build_attach",
     "build_release",
+    "build_tier_gather",
+    "build_tier_restore",
 ]
 
 NULL_PAGE = 0  # reserved: never allocated, target of unallocated table entries
@@ -353,6 +355,51 @@ def build_attach():
         )
 
     return attach
+
+
+def build_tier_gather():
+    """→ ``gather(pool, row) -> (L, 2, W, H, page, dh)``: snapshot one
+    page chain's K/V contents out of every layer for a host-side spill
+    (``serve/tiering.py``).  ``row`` is a fixed-width ``(W,)`` int32 chain
+    padded with NULL_PAGE — padding lanes gather the (zero) null page and
+    are sliced off on the host, so ONE compiled program (width fixed at
+    lowering time, like the attach program) serves any chain length.
+    Layers are stacked in sorted-name order; the restore program uses the
+    same order, so the layer axis round-trips by construction."""
+
+    def gather(pool: PagedPool, row):
+        outs = []
+        for layer in sorted(pool.pages):
+            entry = pool.pages[layer]
+            outs.append(jnp.stack((entry["k"][row], entry["v"][row])))
+        return jnp.stack(outs)
+
+    return gather
+
+
+def build_tier_restore():
+    """→ ``restore(pool, row, payload) -> pool``: scatter a spilled
+    snapshot back into freshly allocated pages — the inverse of
+    :func:`build_tier_gather`, donated like attach/release.  ``row`` is
+    padded with an OUT-OF-RANGE sentinel (``geo.num_pages``) so padding
+    lanes are dropped by the scatter (``mode="drop"``) instead of writing
+    the null page; ``payload`` is the fixed ``(L, 2, W, H, page, dh)``
+    snapshot, zero-padded past the chain length.  Restored pages are
+    byte-for-byte the gathered ones, which is what makes a restored chain
+    bit-identical to one that never left HBM (the digest check upstream
+    guarantees the bytes; this program guarantees the placement)."""
+
+    def restore(pool: PagedPool, row, payload):
+        pages = {}
+        for i, layer in enumerate(sorted(pool.pages)):
+            entry = pool.pages[layer]
+            pages[layer] = {
+                "k": entry["k"].at[row].set(payload[i, 0], mode="drop"),
+                "v": entry["v"].at[row].set(payload[i, 1], mode="drop"),
+            }
+        return pool._replace(pages=pages)
+
+    return restore
 
 
 def build_release():

@@ -46,7 +46,7 @@ from __future__ import annotations
 import hashlib
 from collections import OrderedDict
 from dataclasses import dataclass
-from typing import Dict, List, Optional
+from typing import Dict, List, Optional, Tuple
 
 import numpy as np
 
@@ -137,15 +137,18 @@ class PrefixCache:
         assert e.refs > 0, "release without a matching acquire"
         e.refs -= 1
 
-    def insert(self, h: bytes, chain: List[int]) -> Optional[List[List[int]]]:
+    def insert(self, h: bytes,
+               chain: List[int]) -> Optional[List[Tuple[bytes, List[int]]]]:
         """Take ownership of ``chain`` under ``h``; the inserting request
-        counts as a live sharer (refs=1).  Returns chains EVICTED to make
-        room (the caller frees them), or None when the insert was declined
+        counts as a live sharer (refs=1).  Returns ``(hash, chain)`` pairs
+        EVICTED to make room (the caller frees the chains — or spills them
+        to the tier store, which is why eviction carries the content hash:
+        the hash IS the tier key), or None when the insert was declined
         (duplicate hash, or capacity full of referenced entries) — a
         declined chain stays privately owned by its request."""
         if h in self._entries:
             return None
-        evicted: List[List[int]] = []
+        evicted: List[Tuple[bytes, List[int]]] = []
         while len(self._entries) >= self.capacity:
             victim = self._evict_one()
             if victim is None:
@@ -154,26 +157,32 @@ class PrefixCache:
         self._entries[h] = PrefixEntry(chain=list(chain), refs=1)
         return evicted
 
-    def _evict_one(self) -> Optional[List[int]]:
-        """Drop the least-recently-used UNREFERENCED entry; its chain."""
+    def _evict_one(self) -> Optional[Tuple[bytes, List[int]]]:
+        """Drop the least-recently-used UNREFERENCED entry; its
+        ``(hash, chain)`` pair."""
         for h, e in self._entries.items():  # OrderedDict: LRU first
             if e.refs == 0:
                 del self._entries[h]
-                return e.chain
+                return h, e.chain
         return None
 
-    def evict_for(self, n_pages: int) -> List[List[int]]:
+    def evict_for(self, n_pages: int) -> List[Tuple[bytes, List[int]]]:
         """Demand eviction: free unreferenced entries (LRU first) until at
-        least ``n_pages`` pages are released or none remain eligible."""
-        freed: List[List[int]] = []
+        least ``n_pages`` pages are released or none remain eligible.
+        Returns the evicted ``(hash, chain)`` pairs."""
+        freed: List[Tuple[bytes, List[int]]] = []
         got = 0
         while got < n_pages:
-            chain = self._evict_one()
-            if chain is None:
+            victim = self._evict_one()
+            if victim is None:
                 break
-            freed.append(chain)
-            got += len(chain)
+            freed.append(victim)
+            got += len(victim[1])
         return freed
+
+    def keys(self) -> List[bytes]:
+        """Resident content hashes, LRU first (tier audits read this)."""
+        return list(self._entries)
 
     def clear(self) -> None:
         """Pool rebuild: the device pages are gone — drop every entry and

@@ -126,6 +126,19 @@ _METRICS = {
                          "store-enabled compiles that went cold (any reason)"),
     "cold_start_s": ("gauge", "serve_cold_start_s",
                      "engine bring-up wall time (ctor to programs live)"),
+    # tiered KV page store (serve/tiering.py, ISSUE 16)
+    "tier_host_pages": ("gauge", "serve_tier_host_pages_in_use",
+                        "KV pages resident in the host-RAM tier"),
+    "tier_disk_pages": ("gauge", "serve_tier_disk_pages_in_use",
+                        "KV pages resident in the disk tier"),
+    "tier_spills": ("counter", "serve_tier_spills_total",
+                    "cold chains spilled out of HBM into the tiers"),
+    "tier_demotions": ("counter", "serve_tier_demotions_total",
+                       "host-tier snapshots demoted to the disk tier"),
+    "tier_restores": ("counter", "serve_tier_restores_total",
+                      "digest-verified chains restored into HBM"),
+    "tier_restore_misses": ("counter", "serve_tier_restore_miss_total",
+                            "failed restores degraded to re-prefill"),
 }
 
 
@@ -164,6 +177,14 @@ class ServeStats:
     warmstart_hits = _Backed()
     warmstart_misses = _Backed()
     cold_start_s = _Backed()
+    # tiered KV page store (serve/tiering.py): engine-stamped mirrors of
+    # the store's occupancy gauges and lifetime counters
+    tier_host_pages = _Backed()
+    tier_disk_pages = _Backed()
+    tier_spills = _Backed()
+    tier_demotions = _Backed()
+    tier_restores = _Backed()
+    tier_restore_misses = _Backed()
 
     def __init__(self, num_slots: int,
                  registry: Optional[MetricsRegistry] = None):
@@ -190,6 +211,8 @@ class ServeStats:
         self._page_samples = 0
         self.wait_s: Deque[float] = deque(maxlen=LATENCY_WINDOW)     # submit → admit
         self.latency_s: Deque[float] = deque(maxlen=LATENCY_WINDOW)  # submit → done
+        # per-restore wall time (tier → HBM), the :tiering drill's p95
+        self.tier_restore_s: Deque[float] = deque(maxlen=LATENCY_WINDOW)
         # per-priority-class latency windows: the autoscaler's p95 signal
         # reads class 0 (gold) so brownout-capped low tiers cannot mask an
         # SLO breach on the tier that matters
@@ -230,6 +253,11 @@ class ServeStats:
             self.page_peak = used
         self._page_sum += used
         self._page_samples += 1
+
+    def note_tier_restore(self, seconds: float) -> None:
+        """One tier → HBM restore completed (gather of the stored bytes,
+        digest check, device scatter) in ``seconds`` wall time."""
+        self.tier_restore_s.append(float(seconds))
 
     def record_request(self, submit_t: float, admit_t: float, done_t: float,
                        n_tokens: int, priority: int = 0,
@@ -329,4 +357,11 @@ class ServeStats:
             "kv_page_peak": round(peak, 4),
             "prefix_hit_rate": round(hit_rate, 4),
             "effective_slots": round(eff, 3),
+            # tier ladder (zeros when serve_tiering is off)
+            "tier_host_pages": self.tier_host_pages,
+            "tier_disk_pages": self.tier_disk_pages,
+            "tier_spills": self.tier_spills,
+            "tier_restores": self.tier_restores,
+            "restore_miss_total": self.tier_restore_misses,
+            "tier_restore_p95_s": round(percentile(self.tier_restore_s, 95), 4),
         }

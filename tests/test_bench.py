@@ -67,12 +67,13 @@ def test_alive_tpu_best_variant_wins(bench, monkeypatch, capsys):
     monkeypatch.setattr(bench, "_run_child", fake_child)
     out = _run_main(bench, capsys)
     assert out["device"] == "tpu"
-    # the 4th variant wins: the 5th-9th (bucketed 104, serve 105, fleet
-    # 106, chaos 107, autoscale 108) are excluded from the headline pool —
-    # vs_baseline stays defined on the padded-credit fixed-shape protocol
+    # the 4th variant wins: the 5th-10th (bucketed 104, serve 105, fleet
+    # 106, chaos 107, autoscale 108, tiering 109) are excluded from the
+    # headline pool — vs_baseline stays defined on the padded-credit
+    # fixed-shape protocol
     assert out["value"] == 103.0
     assert "degraded" not in out
-    assert len(out["all_variants"]) == 9
+    assert len(out["all_variants"]) == 10
     # one probe + ONE serve for the whole device group (single claim)
     assert [c[0] for c in calls] == ["--probe", "--serve"]
 
@@ -300,6 +301,44 @@ def test_autoscale_record_fields_survive_embedding(bench, monkeypatch, capsys):
     assert "degraded" not in out  # zero violations: artifact stays clean
 
 
+def test_tiering_record_fields_survive_embedding(bench, monkeypatch, capsys):
+    """A tiering-mode child record's spill/restore drill fields (equal-HBM
+    slot ratio, restore bit-identity verdict, per-tier occupancy, structured
+    miss count) must survive into the final JSON's all_variants — they
+    carry the ISSUE 16 tiered-KV-store claim."""
+    tier_fields = {"trace": "duplicate_storm",
+                   "fault_plan": ["spill_storm", "corrupt_tier_restore",
+                                  "spill_storm"],
+                   "chaos_violations": 0, "invariant_checks": 14,
+                   "effective_slots": 3.0, "restore_bit_identical": True,
+                   "spilled_chains": 4, "tier_spills": 25,
+                   "tier_restores": 9, "restore_miss_total": 6,
+                   "tier_restore_p95_s": 0.008,
+                   "tier_host_pages": 3, "tier_disk_pages": 2,
+                   "outcomes": {"OK": 12}}
+
+    def fake_child(args, timeout_s, cpu_only=False):
+        if args[0] == "--probe":
+            return {"ok": True, "platform": "tpu", "n_devices": 1}, None
+        for spec in args[1].split(","):
+            _emit(bench, {"phase": "start", "spec": spec})
+            rec = _result(spec, 100.0)
+            if rec["mode"] == "tiering":
+                rec.update(tier_fields, num_slots=6)
+            _emit(bench, rec)
+        _emit(bench, {"phase": "done"})
+        return {"ok": True, "phase": "done"}, None
+
+    monkeypatch.setattr(bench, "_run_child", fake_child)
+    out = _run_main(bench, capsys)
+    tier_recs = [v for v in out["all_variants"] if v["mode"] == "tiering"]
+    assert tier_recs, "spec list must carry a tiering variant"
+    for v in tier_recs:
+        for k, want in tier_fields.items():
+            assert v[k] == want, (k, v)
+    assert "degraded" not in out  # zero violations: artifact stays clean
+
+
 def test_autoscale_violations_mark_artifact_degraded(bench, monkeypatch,
                                                      capsys):
     """The autoscale drill rides the same chaos_violations gate: a run
@@ -353,7 +392,7 @@ def test_killed_serve_retries_untried_first(bench, monkeypatch, capsys):
     monkeypatch.setattr(bench, "_run_child", fake_child)
     out = _run_main(bench, capsys)
     assert state["round"] == 2
-    assert len(out["all_variants"]) == 9
+    assert len(out["all_variants"]) == 10
     assert out["value"] == 300.0
     assert "killed during" not in out.get("notes", "")  # retried successfully
 
@@ -379,7 +418,7 @@ def test_deterministic_error_not_retried(bench, monkeypatch, capsys):
     out = _run_main(bench, capsys)
     assert state["serves"] == 1  # error is final: no retry round
     assert "non-finite" in out["notes"]
-    assert len(out["all_variants"]) == 8
+    assert len(out["all_variants"]) == 9
 
 
 def test_malformed_bench_variants_flagged(bench, monkeypatch, capsys):
@@ -421,7 +460,7 @@ def test_done_record_authoritative_over_stdout_marker(bench, monkeypatch, capsys
     out = _run_main(bench, capsys)
     assert state["serves"] == 1  # done record suppressed the retry round
     assert "serve:" not in out.get("notes", "")
-    assert len(out["all_variants"]) == 9
+    assert len(out["all_variants"]) == 10
     assert "degraded" not in out
 
 

@@ -131,7 +131,9 @@ def test_prefix_refcount_pins_until_last_sharer_releases():
     assert cache.evict_for(10) == []     # one sharer still live
     cache.release(h)                     # last sharer retires
     assert cache.pinned_pages == 2       # pinned for the NEXT identical submit
-    assert cache.evict_for(1) == [[3, 4]]  # …and only now evictable
+    # …and only now evictable — eviction carries (hash, chain): the hash
+    # is the tier-store key the engine spills under (ISSUE 16)
+    assert cache.evict_for(1) == [(h, [3, 4])]
     assert len(cache) == 0 and cache.pinned_pages == 0
 
 
@@ -140,7 +142,8 @@ def test_prefix_lru_eviction_and_declined_insert():
     cache.insert(b"a", [1]); cache.release(b"a")
     cache.insert(b"b", [2]); cache.release(b"b")
     cache.acquire(b"a")  # touch: b becomes LRU
-    assert cache.insert(b"c", [3]) == [[2]]  # b evicted, a (referenced) kept
+    # b evicted (as a (hash, chain) pair), a (referenced) kept
+    assert cache.insert(b"c", [3]) == [(b"b", [2])]
     assert cache.insert(b"c", [9]) is None   # duplicate hash: declined
     cache.release(b"c")
     # capacity full of referenced entries: insert declined, cache not grown

@@ -1,0 +1,501 @@
+"""Tiered KV page store drills (ISSUE 16 tentpole).
+
+Pins the spill/restore subsystem's contracts:
+
+* **store ladder** — ``TieredPageStore`` round-trips payload bytes through
+  host RAM and the digest-verified disk tier (warm-start header format),
+  demotes LRU entries past the host budget, evicts LRU files past the
+  disk budget, and never raises out of ``put``/``get``/``clear``;
+* **structured misses** — every advertised failure reason (``absent``,
+  ``corrupt_header``, ``digest_mismatch``, ``io_error``, ``truncated``)
+  comes back as ``(None, None, reason)`` plus a ``tier.restore_miss``
+  event, and the failed entry is dropped so re-prefill repopulates it;
+* **restore bit-identity** — a trace served through a forced
+  spill→restore cycle is token-identical to the same trace served by a
+  never-spilled engine (``check_tokens(label="restore_bit_identity")``);
+* **refcount pins** — a chain with live sharers NEVER spills, even under
+  ``spill_all``; it becomes spillable exactly when the last sharer
+  retires;
+* **corrupted restores degrade** — flipped payload bytes make every
+  restore fail digest verification and the admissions re-prefill to
+  bit-identical outputs (never a crash, never a silently-wrong chain);
+* **rebuild hygiene** — a device-fault rebuild drops allocator, prefix
+  cache AND both tiers together: zero leaked chains
+  (``ServeEngine.chain_leaks() == 0``) after a randomized spill storm;
+* **chaos** (``-m chaos``) — ``spill_storm`` + ``corrupt_tier_restore``
+  fault events driven through strict :func:`run_chaos` on a 2-replica
+  fleet leave every request terminal and every invariant intact.
+"""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from csat_tpu.data.toy import random_request_sample
+from csat_tpu.resilience import (
+    FaultEvent,
+    FaultInjector,
+    FaultPlan,
+    InvariantMonitor,
+    run_chaos,
+)
+from csat_tpu.serve import (
+    Fleet,
+    RequestStatus,
+    ServeEngine,
+    collate_requests,
+    make_trace,
+    zoo_spec,
+)
+from csat_tpu.serve.pages import page_geometry
+from csat_tpu.serve.prefix import sample_hash
+from csat_tpu.serve.tiering import MISS_REASONS, TieredPageStore
+
+SRC_V, TGT_V, TRIP_V = 200, 300, 50
+
+
+class _Recorder:
+    """Minimal obs stand-in: collects (name, fields) emits."""
+
+    def __init__(self):
+        self.events = []
+
+    def emit(self, name, **fields):
+        self.events.append((name, fields))
+
+    def named(self, name):
+        return [f for n, f in self.events if n == name]
+
+
+def _put(store, key, payload, pages):
+    store.put(key, payload, {"pages": pages})
+
+
+# ---------------------------------------------------------------------------
+# store ladder (host-only, no jax, no engine)
+# ---------------------------------------------------------------------------
+
+
+def test_store_roundtrip_demotion_and_disk_format(tmp_path):
+    rec = _Recorder()
+    store = TieredPageStore(host_pages=4, root=str(tmp_path), obs=rec)
+    pa, pb = b"a" * 64, b"b" * 96
+    _put(store, b"A" * 16, pa, 3)
+    _put(store, b"B" * 16, pb, 3)  # host 6 > budget 4: A demotes to disk
+    assert store.has(b"A" * 16) and store.has(b"B" * 16)
+    assert store.pages(b"A" * 16) == 3 and store.pages(b"B" * 16) == 3
+    assert store.host_pages_in_use == 3 and store.disk_pages_in_use == 3
+    assert store.accounting_errors() == 0
+
+    # the demoted entry reuses the warm-start header format on disk
+    path = os.path.join(str(tmp_path), (b"A" * 16).hex() + ".kvp")
+    with open(path, "rb") as f:
+        header = json.loads(f.readline())
+        assert f.read() == pa
+    assert header["magic"] == "csat-kvtier-v1"
+    assert header["key"] == (b"A" * 16).hex()
+    assert header["meta"]["pages"] == 3 and header["meta"]["nbytes"] == 64
+
+    # digest-verified restores from BOTH tiers, byte-identical
+    payload, meta, tier = store.get(b"A" * 16)
+    assert (payload, tier) == (pa, "disk") and meta["pages"] == 3
+    payload, meta, tier = store.get(b"B" * 16)
+    assert (payload, tier) == (pb, "host")
+    assert store.restores == 2 and store.restore_misses == 0
+    names = [n for n, _ in rec.events]
+    assert names.count("tier.spill") == 2
+    assert names.count("tier.demote") == 1
+    assert names.count("tier.restore") == 2
+
+    # restore is NOT a move: get leaves the entry tiered (the ENGINE drops
+    # it once the pages are back in HBM), clear removes files
+    assert len(store) == 2
+    store.clear()
+    assert len(store) == 0 and not os.path.exists(path)
+    assert store.host_pages_in_use == 0 and store.disk_pages_in_use == 0
+
+
+def test_store_disk_budget_evicts_lru_files(tmp_path):
+    store = TieredPageStore(host_pages=1, disk_pages=2, root=str(tmp_path))
+    for i, key in enumerate((b"A" * 16, b"B" * 16, b"C" * 16, b"D" * 16)):
+        _put(store, key, bytes([i]) * 32, 1)
+    # host holds only D; A,B,C demoted; disk budget 2 evicted A's file
+    assert not store.has(b"A" * 16)
+    assert store.has(b"B" * 16) and store.has(b"C" * 16)
+    assert store.disk_pages_in_use == 2
+    assert len([f for f in os.listdir(str(tmp_path))
+                if f.endswith(".kvp")]) == 2
+    assert store.accounting_errors() == 0
+
+
+def test_store_unwritable_root_degrades_to_host_only(tmp_path):
+    blocker = tmp_path / "file"
+    blocker.write_text("x")
+    logs = []
+    # root nested under a FILE: makedirs fails, store must come up host-only
+    store = TieredPageStore(host_pages=1, root=str(blocker / "tiers"),
+                            log=logs.append)
+    assert store.root is None and logs
+    _put(store, b"A" * 16, b"a" * 8, 1)
+    _put(store, b"B" * 16, b"b" * 8, 1)  # overflow: A dropped, not demoted
+    assert not store.has(b"A" * 16) and store.has(b"B" * 16)
+    assert store.get(b"A" * 16) == (None, None, "absent")
+
+
+def test_store_every_miss_reason_is_structured(tmp_path):
+    """Each advertised failure mode: (None, None, reason) + one
+    ``tier.restore_miss{reason}`` event + the entry dropped — never an
+    exception.  Together the cases cover the full MISS_REASONS alphabet."""
+    rec = _Recorder()
+    store = TieredPageStore(root=str(tmp_path), obs=rec)
+    seen = {}
+
+    def miss(key, expect):
+        payload, meta, reason = store.get(key)
+        assert (payload, meta, reason) == (None, None, expect)
+        assert not store.has(key), "failed entry must be dropped"
+        seen[expect] = True
+
+    # absent: never stored
+    miss(b"Z" * 16, "absent")
+
+    # host truncated: payload shorter than the recorded nbytes
+    _put(store, b"T" * 16, b"t" * 32, 1)
+    store._host[b"T" * 16].payload = b"t" * 16
+    miss(b"T" * 16, "truncated")
+
+    # host digest_mismatch: flipped bytes, recorded digest kept
+    _put(store, b"D" * 16, b"d" * 32, 1)
+    store._host[b"D" * 16].payload = b"X" * 32
+    miss(b"D" * 16, "digest_mismatch")
+
+    def demote(key, payload):
+        _put(store, key, payload, 1)
+        store.host_budget = 1
+        _put(store, b"\xee" * 16, b"e" * 8, 1)  # push key down to disk
+        store.host_budget = 0
+        store.drop(b"\xee" * 16)
+        assert key in store._disk
+        return os.path.join(str(tmp_path), key.hex() + ".kvp")
+
+    # disk corrupt_header: header line is not the store's JSON
+    path = demote(b"H" * 16, b"h" * 32)
+    with open(path, "wb") as f:
+        f.write(b"not a header\n" + b"h" * 32)
+    miss(b"H" * 16, "corrupt_header")
+    assert not os.path.exists(path)
+
+    # disk io_error: the file vanished out from under the index
+    path = demote(b"I" * 16, b"i" * 32)
+    os.remove(path)
+    miss(b"I" * 16, "io_error")
+
+    # disk truncated: intact header, short payload
+    path = demote(b"U" * 16, b"u" * 32)
+    with open(path, "rb") as f:
+        header = f.readline()
+    with open(path, "wb") as f:
+        f.write(header + b"u" * 8)
+    miss(b"U" * 16, "truncated")
+
+    # disk digest_mismatch: corrupt_entries flips bytes, keeps digests
+    demote(b"C" * 16, b"c" * 32)
+    assert store.corrupt_entries() == 1
+    miss(b"C" * 16, "digest_mismatch")
+
+    # caller-detected skew routes through the same structured channel
+    _put(store, b"S" * 16, b"s" * 32, 1)
+    store.invalidate(b"S" * 16, "truncated")
+    assert not store.has(b"S" * 16)
+
+    assert seen.keys() >= set(MISS_REASONS) - {"absent"} and seen["absent"]
+    events = rec.named("tier.restore_miss")
+    assert len(events) == store.restore_misses == 8
+    assert {e["reason"] for e in events} == set(MISS_REASONS)
+    assert store.accounting_errors() == 0
+
+
+# ---------------------------------------------------------------------------
+# engine drills: spill/restore through the serving stack
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def tier_pair(micro_config, tmp_path_factory):
+    """(cfg, tiered_engine, plain_engine) over one shared model.  Both run
+    the SAME deliberately tight pool (half the slots' worst case, constant
+    spill pressure); the plain engine is the never-spilled reference for
+    every bit-identity assertion."""
+    from csat_tpu.train.state import create_train_state, default_optimizer, make_model
+
+    cfg = micro_config.replace(
+        full_att=True, dropout=0.0, attention_dropout=0.0,
+        cse_empty_rows="zero", serve_slots=4, bucket_src_lens=(48,),
+        serve_page_size=4, serve_tiering=True, serve_tier_host_pages=8,
+        serve_tier_dir=str(tmp_path_factory.mktemp("kv_tiers")))
+    model = make_model(cfg, SRC_V, TGT_V, TRIP_V)
+    warm = collate_requests(
+        [random_request_sample(cfg, SRC_V, TRIP_V, 8, seed=0)],
+        cfg.max_src_len, 1, cfg, tgt_width=cfg.max_tgt_len - 1)
+    params = create_train_state(
+        model, default_optimizer(cfg), warm, seed=0).params
+    geo = page_geometry(cfg)
+    tight = cfg.replace(
+        serve_num_pages=1 + cfg.serve_slots * geo.rect_pages_per_slot // 2)
+    tiered = ServeEngine(model, params, tight, sample_seed=1)
+    plain = ServeEngine(model, params, tight.replace(serve_tiering=False),
+                        sample_seed=1)
+    yield cfg, tiered, plain
+    tiered.close()
+    plain.close()
+
+
+def _trace(cfg, n, seed):
+    rng = np.random.default_rng(seed)
+    return [
+        random_request_sample(cfg, SRC_V, TRIP_V, int(ln), seed=700 * seed + i)
+        for i, ln in enumerate(rng.integers(5, cfg.max_src_len, n))
+    ]
+
+
+def _reset(eng):
+    """Start a drill from a cold cache + empty tiers (the module-shared
+    engines keep state across tests)."""
+    assert eng.occupancy == 0 and eng.queue_depth == 0
+    for _h, chain in eng._prefix.evict_for(1 << 30):
+        eng._allocator.free(chain)
+    if eng._tiers is not None:
+        eng._tiers.clear()
+
+
+def _no_leaks(eng):
+    assert eng.occupancy == 0 and eng.queue_depth == 0
+    assert eng.page_leaks() == 0
+    assert eng.chain_leaks() == 0
+
+
+def test_spill_restore_bit_identity(tier_pair):
+    """Warm both engines on the same trace, force-spill the tiered one's
+    whole warm set, replay: the tiered engine serves through tier restores
+    and its tokens match the never-spilled reference token-for-token."""
+    cfg, tiered, plain = tier_pair
+    _reset(tiered)
+    _reset(plain)
+    samples = _trace(cfg, 6, seed=1)
+    ref = {i: np.asarray(r.tokens) for i, r in
+           enumerate(plain.generate(samples, max_new_tokens=4))}
+    first = tiered.generate(samples, max_new_tokens=4)
+    assert all(r.status == RequestStatus.OK for r in first)
+
+    spilled = tiered.spill_all()
+    assert spilled > 0 and len(tiered._prefix) == 0
+    assert len(tiered._tiers) >= spilled
+    assert tiered._tiers.host_pages_in_use + tiered._tiers.disk_pages_in_use > 0
+
+    r0 = tiered._tiers.restores
+    got = {i: np.asarray(r.tokens) for i, r in
+           enumerate(tiered.generate(samples, max_new_tokens=4))}
+    assert tiered._tiers.restores > r0, "replay must restore from the tiers"
+    assert tiered._tiers.restore_misses == 0
+
+    mon = InvariantMonitor(cfg)
+    mon.check_tokens(ref, got, label="restore_bit_identity")
+    assert mon.violations == [], mon.violations
+    # restored admissions ARE prefix hits: the encoder never re-ran
+    assert tiered.stats.prefix_hits >= len(samples)
+    _no_leaks(tiered)
+
+
+def test_restore_events_and_gauges_flow_to_stats(tier_pair):
+    """The per-tier gauges and restore latency land in the stats summary
+    (the surface the metrics JSONL / ``csat_tpu top`` tier columns read),
+    and spill/restore produce their structured events."""
+    cfg, tiered, _ = tier_pair
+    _reset(tiered)
+    samples = _trace(cfg, 4, seed=2)
+    tiered.generate(samples, max_new_tokens=3)
+    tiered.spill_all()
+    tiered.generate(samples, max_new_tokens=3)
+    s = tiered.stats.summary()
+    assert s["tier_spills"] > 0 and s["tier_restores"] > 0
+    assert s["restore_miss_total"] == 0
+    assert s["tier_restore_p95_s"] >= 0.0
+    # gauges mirror the store's occupancy (everything restored: both 0 now
+    # unless pressure re-spilled — reconcile against the store, not zero)
+    assert s["tier_host_pages"] == tiered._tiers.host_pages_in_use
+    assert s["tier_disk_pages"] == tiered._tiers.disk_pages_in_use
+    names = [n for _, n, _, f in tiered.obs.events()]
+    assert "tier.spill" in names and "tier.restore" in names
+    _no_leaks(tiered)
+
+
+def test_live_sharers_pin_chain_against_spill(tier_pair):
+    """``spill_all`` mid-decode: an entry with live sharers never spills
+    (its pages are referenced by slots), and becomes spillable exactly
+    when the last sharer retires."""
+    cfg, tiered, _ = tier_pair
+    _reset(tiered)
+    dup = random_request_sample(cfg, SRC_V, TRIP_V, 11, seed=55)
+    h = sample_hash(dup)
+    ids = [tiered.submit(dup, max_new_tokens=6)]
+    t = 0
+    while h not in tiered._prefix._entries:
+        tiered.tick()
+        t += 1
+        assert t < 30, "chain never published"
+    ids.append(tiered.submit(dup, max_new_tokens=6))
+    tiered.tick()  # the hit attaches
+    assert tiered._prefix._entries[h].refs > 0
+
+    tiered.spill_all()
+    assert h in tiered._prefix._entries, "referenced chain must not spill"
+    assert not tiered._tiers.has(h)
+
+    tiered.drain()
+    assert all(tiered.pop_result(i).status == RequestStatus.OK for i in ids)
+    assert tiered.spill_all() >= 1  # last sharer retired: now spillable
+    assert tiered._tiers.has(h)
+    _no_leaks(tiered)
+
+
+def test_corrupted_restore_degrades_to_reprefill(tier_pair):
+    """Flip every tiered snapshot's payload bytes: each restore attempt
+    fails digest verification as a structured ``tier.restore_miss`` and
+    the admission re-prefills — outputs stay bit-identical to the
+    never-spilled reference, nothing raises, nothing is silently wrong."""
+    cfg, tiered, plain = tier_pair
+    _reset(tiered)
+    _reset(plain)
+    samples = _trace(cfg, 5, seed=3)
+    ref = {i: np.asarray(r.tokens) for i, r in
+           enumerate(plain.generate(samples, max_new_tokens=4))}
+    tiered.generate(samples, max_new_tokens=4)
+    tiered.spill_all()
+    assert tiered.corrupt_tiers() > 0
+
+    m0 = tiered._tiers.restore_misses
+    got = {i: np.asarray(r.tokens) for i, r in
+           enumerate(tiered.generate(samples, max_new_tokens=4))}
+    assert tiered._tiers.restore_misses > m0
+    assert tiered.stats.tier_restore_misses == tiered._tiers.restore_misses
+
+    mon = InvariantMonitor(cfg)
+    mon.check_tokens(ref, got, label="restore_bit_identity")
+    assert mon.violations == [], mon.violations
+    # the misses are structured events with a digest reason
+    missed = [f for _, n, _, f in tiered.obs.events()
+              if n == "tier.restore_miss"]
+    assert missed and all(f["reason"] in MISS_REASONS for f in missed)
+    assert any(f["reason"] == "digest_mismatch" for f in missed)
+    _no_leaks(tiered)
+
+
+def test_rebuild_drops_all_tiers_no_leak_storm(tier_pair):
+    """Randomized spill-storm rounds, then a device-fault rebuild
+    mid-flight: allocator, prefix cache AND both tiers reset together —
+    zero leaked chains, zero stale tier files, and the resubmitted
+    requests still complete."""
+    cfg, tiered, _ = tier_pair
+    _reset(tiered)
+    rng = np.random.default_rng(7)
+    ids = []
+    for round_ in range(4):
+        for s in _trace(cfg, int(rng.integers(2, 5)), seed=40 + round_):
+            ids.append(tiered.submit(s, max_new_tokens=int(rng.integers(0, 6))))
+        for _ in range(int(rng.integers(1, 4))):
+            tiered.tick()
+        tiered.spill_all()
+    tiered.fault_injector = FaultInjector(
+        serve_decode_fail_ticks=[tiered._tick_no + 1])
+    try:
+        t = 0
+        while tiered.stats.rebuilds == 0:
+            tiered.tick()
+            t += 1
+            assert t < 50, "injected decode fault never fired"
+        # the rebuild just fired: every layer reset in the same breath
+        assert tiered._allocator.used_pages == 0
+        assert len(tiered._prefix) == 0
+        assert len(tiered._tiers) == 0
+        assert tiered._tiers.host_pages_in_use == 0
+        assert tiered._tiers.disk_pages_in_use == 0
+        assert not [f for f in os.listdir(tiered.cfg.serve_tier_dir)
+                    if f.endswith(".kvp")], "stale tier files after rebuild"
+        tiered.drain()
+    finally:
+        tiered.fault_injector = None
+        tiered._rebuilds = 0
+    assert all(tiered.pop_result(i).status == RequestStatus.OK for i in ids)
+    _no_leaks(tiered)
+
+
+# ---------------------------------------------------------------------------
+# chaos: the two tier fault kinds through strict run_chaos
+# ---------------------------------------------------------------------------
+
+
+def test_random_plans_draw_tier_kinds_only_when_tiered():
+    drawn = set()
+    for seed in range(12):
+        for e in FaultPlan.random(seed, n_events=4, tiered=True).events:
+            drawn.add(e.kind)
+        for e in FaultPlan.random(seed, n_events=4).events:
+            assert e.kind not in ("spill_storm", "corrupt_tier_restore")
+    assert {"spill_storm", "corrupt_tier_restore"} <= drawn
+
+
+def test_tiering_config_requires_paged_prefix():
+    from csat_tpu.configs import get_config
+
+    with pytest.raises(AssertionError):
+        get_config("python", serve_tiering=True, serve_kv_layout="rect")
+    with pytest.raises(AssertionError):
+        get_config("python", serve_tiering=True, serve_prefix_cache=0)
+    with pytest.raises(AssertionError):
+        get_config("python", serve_tier_host_pages=-1)
+
+
+@pytest.mark.slow
+@pytest.mark.chaos
+def test_chaos_spill_storm_and_corrupt_restore_fleet(
+        micro_config, tmp_path_factory):
+    """Both tier fault kinds on BOTH replicas of a tiered 2-replica fleet
+    under a duplicate-heavy trace, strict invariants armed: spill storms
+    force the warm set down the ladder mid-traffic, corruption makes the
+    restores fail structured — and the run must drain clean (every
+    request terminal, no_chain_leak / page_leak / exactly-one-terminal
+    all intact)."""
+    from csat_tpu.train.state import create_train_state, default_optimizer, make_model
+
+    # host-only tiers (unbounded host budget): replicas share no disk dir
+    cfg = micro_config.replace(
+        full_att=True, dropout=0.0, attention_dropout=0.0,
+        cse_empty_rows="zero", serve_slots=2, bucket_src_lens=(48,),
+        serve_page_size=4, serve_tiering=True,
+        serve_tier_dir=str(tmp_path_factory.mktemp("fleet_tiers")))
+    model = make_model(cfg, SRC_V, TGT_V, TRIP_V)
+    warm = collate_requests(
+        [random_request_sample(cfg, SRC_V, TRIP_V, 8, seed=0)],
+        cfg.max_src_len, 1, cfg, tgt_width=cfg.max_tgt_len - 1)
+    params = create_train_state(
+        model, default_optimizer(cfg), warm, seed=0).params
+
+    fleet = Fleet(model, params, cfg, replicas=2, sample_seed=0)
+    plan = FaultPlan(name="tier_storm", events=tuple(
+        FaultEvent(kind=kind, at=at, count=3, replica=rep)
+        for rep in (0, 1)
+        for kind, at in (("spill_storm", 2), ("corrupt_tier_restore", 6),
+                         ("spill_storm", 9))))
+    trace = make_trace(zoo_spec("duplicate_storm", 12, seed=5),
+                       cfg, SRC_V, TRIP_V)
+    mon = InvariantMonitor(cfg)
+    report = run_chaos(fleet, trace, plan=plan, monitor=mon, strict=True)
+    assert report.clean and report.checks > 0
+    assert "UNRESOLVED" not in report.outcomes
+    assert sum(report.outcomes.values()) == len(trace.items)
+    names = {e["name"] for e in report.timeline}
+    assert "fault.injected.spill_storm" in names
+    assert "tier.spill" in names
+    fleet.close()

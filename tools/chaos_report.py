@@ -30,12 +30,13 @@ from typing import List, Tuple
 
 # event-name prefixes that make the condensed timeline: injected faults,
 # the degradation ladder acting, the invariant monitor's verdicts, the
-# elastic-fleet lifecycle (spawn/heal — ISSUE 13), and SLO burn-rate
-# alert transitions (ISSUE 14)
+# elastic-fleet lifecycle (spawn/heal — ISSUE 13), SLO burn-rate alert
+# transitions (ISSUE 14), and the tiered KV store's spill/demote/restore/
+# restore_miss ladder (ISSUE 16)
 TIMELINE_PREFIXES = (
     "fault.", "invariant.", "req.brownout", "fleet.shed_oldest",
     "fleet.retire", "fleet.resubmit", "fleet.backoff", "fleet.draining",
-    "fleet.spawn", "autoscale.", "slo.",
+    "fleet.spawn", "autoscale.", "slo.", "tier.",
 )
 
 

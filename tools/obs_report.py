@@ -188,6 +188,40 @@ def fleet_table(snaps: List[dict]) -> str:
         ("replica", "health", *(c for c, _ in _FLEET_COLS), "lat_mean_ms"))
 
 
+# per-tier occupancy gauges (serve/tiering.py, ISSUE 16): shown next to
+# the HBM page occupancy whenever any replica reports them
+_TIER_COLS = (
+    ("host", "serve_tier_host_pages_in_use"),
+    ("disk", "serve_tier_disk_pages_in_use"),
+)
+
+
+def kv_pages_table(snaps: List[dict]) -> str:
+    """KV page occupancy per replica — HBM in-use / usable (plus peak),
+    with the host/disk tier residency columns when any replica runs the
+    tiered store.  Rectangle-layout replicas (0 usable pages) are skipped;
+    returns "" when nothing is paged."""
+    tiered = any(s.get(key) is not None for s in snaps for _, key in _TIER_COLS)
+    rows: List[Tuple] = []
+    for k, s in enumerate(snaps):
+        usable = s.get("serve_kv_pages") or 0
+        if not usable:
+            continue
+        used = s.get("serve_kv_pages_in_use") or 0
+        row: List = [f"replica{s.get('_index', k)}", used, usable,
+                     f"{used / usable:.1%}", s.get("serve_kv_pages_peak") or 0]
+        if tiered:
+            row += [s[key] if s.get(key) is not None else "-"
+                    for _, key in _TIER_COLS]
+        rows.append(tuple(row))
+    if not rows:
+        return ""
+    headers: Tuple = ("replica", "hbm_in_use", "usable", "occ", "peak")
+    if tiered:
+        headers += tuple(c for c, _ in _TIER_COLS)
+    return _fmt_table(rows, headers)
+
+
 def trace_lines(path: str, slowest: int = 5) -> List[str]:
     """The slowest-N request traces from a ``Tracer.dump`` JSONL artifact
     (ISSUE 14) as indented span trees — one header row per trace (id,
@@ -285,6 +319,9 @@ def report(metrics_path: Optional[str] = None,
             section += (f"\nlifecycle: {spawned} spawned, "
                         f"{retired} retired")
         sections.append(section)
+        pages = kv_pages_table(snaps)
+        if pages:
+            sections.append("== kv pages (per tier) ==\n" + pages)
     if metrics_path:
         snaps = load_metrics(metrics_path)
         if snaps:
@@ -300,6 +337,9 @@ def report(metrics_path: Optional[str] = None,
                 sections.append(
                     f"mean OK latency: {lat_sum / lat_n * 1e3:.1f} ms "
                     f"over {lat_n} request(s)")
+            pages = kv_pages_table([last])
+            if pages:
+                sections.append("== kv pages (per tier) ==\n" + pages)
     if events_path:
         meta, events = load_events(events_path)
         title = meta.get("component") or meta.get("source") or "events"

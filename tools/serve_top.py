@@ -36,6 +36,7 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 from tools.obs_report import (  # noqa: E402
     _fmt_table,
     fleet_table,
+    kv_pages_table,
     split_fleet_snapshot,
     trace_lines,
 )
@@ -94,22 +95,15 @@ def header_lines(snap: dict, n_snaps: int) -> List[str]:
 
 
 def pages_lines(snaps: List[dict]) -> List[str]:
-    """KV page occupancy per replica: in-use / usable (peak in brackets).
-    Rectangle-layout replicas (0 usable pages) are skipped."""
-    rows = []
-    for k, s in enumerate(snaps):
-        usable = _g(s, "serve_kv_pages")
-        if not usable:
-            continue
-        used = _g(s, "serve_kv_pages_in_use")
-        rows.append((f"replica{s.get('_index', k)}", used, usable,
-                     f"{used / usable:.1%}",
-                     _g(s, "serve_kv_pages_peak")))
-    if not rows:
+    """KV page occupancy per replica: HBM in-use / usable / peak, plus the
+    host/disk tier residency columns whenever a replica serves with the
+    tiered store (shared renderer with ``tools/obs_report.py``, which is
+    where the column set lives).  Rectangle-layout replicas (0 usable
+    pages) are skipped."""
+    table = kv_pages_table(snaps)
+    if not table:
         return []
-    return ["== kv pages ==",
-            *_fmt_table(rows, ("replica", "in_use", "usable", "occ",
-                               "peak")).splitlines()]
+    return ["== kv pages ==", *table.splitlines()]
 
 
 def slo_lines(snap: dict) -> List[str]:
