@@ -314,6 +314,10 @@ def _measure_one(spec: str, heartbeat=None) -> dict:
     if mode == "fleet":
         # batch field = slots PER REPLICA, steps field = request count
         return _measure_fleet(backend, dtype, batch_size, n_steps, heartbeat)
+    if mode == "mesh_serve":
+        # batch field = slot-pool size, steps field = request count
+        return _measure_mesh_serve(backend, dtype, batch_size, n_steps,
+                                   heartbeat)
     if mode == "chaos":
         # batch field = slots per replica, steps field = per-phase requests
         return _measure_chaos(backend, dtype, batch_size, n_steps, heartbeat)
@@ -1106,6 +1110,194 @@ def _measure_fleet(backend: str, dtype: str, num_slots: int,
     return rec
 
 
+def _measure_mesh_serve(backend: str, dtype: str, num_slots: int,
+                        n_requests: int, heartbeat=None) -> dict:
+    """Mesh-sharded serving (ISSUE 17): ONE engine replica spanning chips
+    (``serve_mesh_shape``, head-sharded paged KV) vs a solo engine over
+    the SAME Poisson request trace.
+
+    Protocol — equal-chip accounting: the trace runs once per topology
+    (solo, then every mesh shape the host can place) at identical engine
+    geometry, and each run's token throughput is divided by ITS OWN chip
+    count, so ``vs_solo_per_chip`` is the honest question "what does a
+    token cost per chip once the replica spans N of them".  On CPU the
+    chips are the 8 virtual devices this spec's own serve child forces
+    (``--xla_force_host_platform_device_count=8``, mirroring
+    ``tests/conftest.py`` — the spec gets a private child precisely so
+    the flag cannot deflate any other spec's per-chip numbers).
+
+    The drill's claims, recorded per run: every mesh run is bit-identical
+    to the solo reference (tokens AND terminal statuses —
+    ``sharded_bit_identical``), steady state stays at zero recompiles,
+    and the dispatch-vs-device-wait phase split shows where the mesh
+    moved the tick's time.  The record is excluded from the padded-credit
+    headline (generated tokens, not fed nodes) and rides the perf ledger
+    like every other variant.
+    """
+    import jax
+    import numpy as np
+
+    from csat_tpu.configs import get_config
+    from csat_tpu.data.toy import random_request_sample
+    from csat_tpu.serve.engine import RequestStatus, ServeEngine
+    from csat_tpu.serve.prefill import collate_requests
+
+    overrides = dict(backend=backend, compute_dtype=dtype, prefetch=0,
+                     serve_slots=num_slots,
+                     # bit-identity paths (serve exactness-test config)
+                     full_att=True, dropout=0.0, attention_dropout=0.0,
+                     cse_empty_rows="zero")
+    if backend == "pallas":
+        overrides["noise_mode"] = "counter"
+    probe = get_config("python", **overrides)
+    overrides["bucket_src_lens"] = (probe.max_src_len,)
+    cfg = get_config("python", **overrides)
+    src_v, tgt_v, trip_v = 10_000, 20_000, 1246
+    steps = cfg.max_tgt_len - 1
+    rng = np.random.default_rng(3)
+    lengths = _skewed_lengths(rng, n_requests, cfg.max_src_len)
+    budgets = np.clip(
+        (steps * rng.lognormal(mean=-1.0, sigma=0.5, size=n_requests)).astype(int),
+        2, steps)
+    samples = [
+        random_request_sample(cfg, src_v, trip_v, int(lengths[i]), seed=300 + i)
+        for i in range(n_requests)
+    ]
+
+    from csat_tpu.train.state import create_train_state, default_optimizer, make_model
+
+    model = make_model(cfg, src_v, tgt_v, trip_v)
+    warm = collate_requests(samples[:1], cfg.max_src_len, num_slots, cfg,
+                            tgt_width=steps)
+    params = create_train_state(
+        model, default_optimizer(cfg), warm, seed=cfg.seed).params
+
+    def run_trace(engine):
+        """Same arrival schedule every run: the rng is re-seeded per run
+        and the scale uses the per-ENGINE slot count (topology changes
+        the chips under one engine, not its slot pool)."""
+        arr_rng = np.random.default_rng(4)
+        arrivals = np.cumsum(arr_rng.exponential(
+            scale=float(budgets.mean()) / max(num_slots, 1) / 1.4,
+            size=n_requests))
+        t0 = time.perf_counter()
+        step_clock, nxt, ids = 0, 0, []
+        while nxt < n_requests or engine.occupancy or engine.queue_depth:
+            while nxt < n_requests and arrivals[nxt] <= step_clock:
+                ids.append(engine.submit(samples[nxt],
+                                         max_new_tokens=int(budgets[nxt])))
+                nxt += 1
+            live = engine.tick()
+            step_clock += 1
+            if not live and not engine.queue_depth and nxt < n_requests:
+                step_clock = max(step_clock, int(np.ceil(arrivals[nxt])))
+        wall = time.perf_counter() - t0
+        return wall, [engine.poll(i) for i in ids]
+
+    n_devices = jax.device_count()
+    shapes = [()]
+    skipped = []
+    for shape in ((1, 2), (1, 4)):
+        devs = int(np.prod(shape))
+        if devs > n_devices or cfg.num_heads % devs:
+            skipped.append({"mesh_shape": list(shape),
+                            "reason": f"{n_devices} devices, "
+                                      f"{cfg.num_heads} heads"})
+        else:
+            shapes.append(shape)
+
+    t_compile = 0.0
+    runs = []
+    ref = None
+    for shape in shapes:
+        t0c = time.perf_counter()
+        eng = ServeEngine(model, params,
+                          cfg.replace(serve_mesh_shape=shape), sample_seed=1)
+        mesh_devs = 1 if eng.mesh is None else eng.mesh.size
+        eng.generate(
+            [random_request_sample(cfg, src_v, trip_v, spec.n, seed=30 + i)
+             for i, spec in enumerate(eng.specs)],
+            max_new_tokens=2)
+        compiles_warm = eng.stats.compiles
+        t_compile += time.perf_counter() - t0c
+        if heartbeat is not None:
+            heartbeat({"phase": "compiled", "mesh_shape": list(shape),
+                       "compile_s": round(t_compile, 1),
+                       "programs": int(compiles_warm)})
+        eng.reset_stats()
+        wall, reqs = run_trace(eng)
+        assert eng.stats.compiles == compiles_warm, "steady-state recompile!"
+        useful = sum(r.n_tokens for r in reqs)
+        outs = [(r.status, r.n_tokens, np.asarray(r.tokens)) for r in reqs]
+        if ref is None:
+            ref = outs  # the solo run is first: everything compares to it
+        identical = all(
+            a[0] == b[0] and a[1] == b[1] and np.array_equal(a[2], b[2])
+            for a, b in zip(ref, outs))
+        pt = eng.obs.totals
+        runs.append({
+            "mesh_shape": list(shape),
+            "mesh_devices": mesh_devs,
+            "wall_s": round(wall, 3),
+            "gen_tokens": int(useful),
+            "tps_per_chip": round(useful / wall / mesh_devs, 2),
+            "bit_identical": identical,
+            "ok_requests": sum(1 for r in reqs
+                               if r.status == RequestStatus.OK),
+            "programs": int(compiles_warm),
+            # dispatch-vs-device-wait split (host clocks): did sharding
+            # move tick time into enqueue or into the status fetch?
+            "decode_dispatch_s": round(pt.get("tick.decode_dispatch", 0.0), 4),
+            "device_wait_s": round(pt.get("tick.status_fetch", 0.0), 4),
+        })
+        eng.close()
+
+    solo_run = runs[0]
+    mesh_runs = runs[1:]
+    # headline mesh number: the widest topology that actually ran
+    head = mesh_runs[-1] if mesh_runs else solo_run
+    rec = {
+        "ok": True,
+        "backend": backend,
+        "dtype": dtype,
+        "mode": "mesh_serve",
+        "noise_mode": cfg.noise_mode,
+        "device": jax.devices()[0].platform,
+        "n_chips": head["mesh_devices"],
+        "loss": 0.0,
+        "compile_s": round(t_compile, 1),
+        "steps": 0,
+        "step_ms": round(head["wall_s"] / max(head["gen_tokens"], 1) * 1e3, 2),
+        "num_slots": num_slots,
+        "requests": n_requests,
+        "programs": int(sum(r["programs"] for r in runs)),
+        "gen_tokens": head["gen_tokens"],
+        "gen_tokens_per_sec_per_chip": head["tps_per_chip"],
+        "mesh_variants": runs,
+        "mesh_skipped": skipped,
+        "mesh_shape": head["mesh_shape"],
+        "mesh_devices": head["mesh_devices"],
+        "mesh_tps_per_chip": head["tps_per_chip"],
+        "solo_tps_per_chip": solo_run["tps_per_chip"],
+        "vs_solo_per_chip": round(
+            head["tps_per_chip"] / solo_run["tps_per_chip"], 3)
+        if solo_run["tps_per_chip"] > 0 else 0.0,
+        "sharded_bit_identical": bool(mesh_runs) and all(
+            r["bit_identical"] for r in mesh_runs),
+        "phase_time": {
+            "decode_dispatch_s": head["decode_dispatch_s"],
+            "device_wait_s": head["device_wait_s"],
+            "solo_decode_dispatch_s": solo_run["decode_dispatch_s"],
+            "solo_device_wait_s": solo_run["device_wait_s"],
+        },
+        # keep the shared-record contract so the variant table renders
+        "nodes_per_sec_per_chip": 0.0,
+        "real_nodes_per_sec_per_chip": 0.0,
+    }
+    _record_variant_metrics(rec, t_compile)
+    return rec
+
+
 def _measure_chaos(backend: str, dtype: str, num_slots: int,
                    n_requests: int, heartbeat=None) -> dict:
     """Chaos proving ground (ISSUE 12): a full FaultPlan under an
@@ -1719,6 +1911,16 @@ def _serve(specs_csv: str, soft_budget_s: float) -> None:
     cpu_only = all(s.split(":")[2] == "cpu" for s in specs)
     if cpu_only:
         os.environ["JAX_PLATFORMS"] = "cpu"
+    if any((s.split(":") + [""] * 6)[5] == "mesh_serve" for s in specs):
+        # the mesh-serve drill needs chips to span: force the 8-virtual-
+        # device CPU platform (tests/conftest.py's fake-distributed
+        # backend) BEFORE jax import.  The parent routes mesh_serve specs
+        # to their own child, so this flag never touches the per-chip
+        # numbers of any other spec.
+        flags = os.environ.get("XLA_FLAGS", "")
+        if "xla_force_host_platform_device_count" not in flags:
+            os.environ["XLA_FLAGS"] = (
+                flags + " --xla_force_host_platform_device_count=8").strip()
     import jax
 
     if cpu_only:
@@ -2018,6 +2220,9 @@ def main() -> None:
             # tiered KV page store: 3x slots over a 1x page budget with
             # spill storms + a corrupted-restore fault — see _measure_tiering
             "xla:float32:default:8:24:tiering",
+            # mesh-sharded serving: one replica spanning chips, equal-chip
+            # solo-vs-mesh protocol — see _measure_mesh_serve (own child)
+            "xla:float32:default:8:24:mesh_serve",
         ]
     else:
         # honest CPU comparison: f32 at batch 6 — both frameworks' measured
@@ -2050,6 +2255,11 @@ def main() -> None:
             # spill_storm / corrupt_tier_restore fault schedule — see
             # _measure_tiering
             "xla:float32:cpu:2:6:tiering",
+            # mesh-sharded serving (2 slots, 6 requests): solo vs (1,2) vs
+            # (1,4) head-sharded topologies on the forced 8-virtual-device
+            # platform, equal-chip accounting + bit-identity — runs in its
+            # OWN serve child (see _groups) — see _measure_mesh_serve
+            "xla:float32:cpu:2:6:mesh_serve",
         ]
 
     # -- phase 2: one serve child per platform group (one chip claim for all
@@ -2060,9 +2270,14 @@ def main() -> None:
     RESERVE = 200 if tpu_alive else 45
 
     def _groups(ss: list) -> list:
-        cpu = [s for s in ss if s.split(":")[2] == "cpu"]
-        dev = [s for s in ss if s.split(":")[2] != "cpu"]
-        return [g for g in (cpu, dev) if g]
+        # mesh_serve runs in its OWN child: it forces an 8-virtual-device
+        # CPU platform before jax import, which would deflate every other
+        # spec's per-chip numbers 8x if they shared the interpreter
+        mesh = [s for s in ss if (s.split(":") + [""] * 6)[5] == "mesh_serve"]
+        rest = [s for s in ss if s not in mesh]
+        cpu = [s for s in rest if s.split(":")[2] == "cpu"]
+        dev = [s for s in rest if s.split(":")[2] != "cpu"]
+        return [g for g in (cpu, dev, mesh) if g]
 
     def _n_done() -> int:
         return sum(1 for p in _read_results()[1] if p.get("phase") == "done")
@@ -2223,7 +2438,8 @@ def main() -> None:
                 if not (r["device"] == "cpu" and r["backend"] == "pallas")
                 and r.get("mode", "fixed") not in ("bucketed", "serve",
                                                    "fleet", "chaos",
-                                                   "autoscale", "tiering")]
+                                                   "autoscale", "tiering",
+                                                   "mesh_serve")]
         pool = real or results
         best = max(pool, key=lambda r: r["nodes_per_sec_per_chip"])
         value = best["nodes_per_sec_per_chip"]
@@ -2314,7 +2530,13 @@ def main() -> None:
                                      "spilled_chains", "tier_spills",
                                      "tier_restores", "restore_miss_total",
                                      "tier_restore_p95_s", "tier_host_pages",
-                                     "tier_disk_pages")
+                                     "tier_disk_pages",
+                                     # mesh-sharded serving (ISSUE 17)
+                                     "mesh_shape", "mesh_devices",
+                                     "mesh_variants", "mesh_skipped",
+                                     "mesh_tps_per_chip",
+                                     "vs_solo_per_chip",
+                                     "sharded_bit_identical")
                    if k in r}
             # self-describing artifact (r4 verdict weak #6): pallas on CPU is
             # pl.pallas_call(interpret=True) — a correctness canary, not a

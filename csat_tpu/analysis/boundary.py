@@ -1,6 +1,7 @@
 """Boundary rules: the layer manifests, enforced.
 
-Four rules, one per invariant the old ``TestStatic*`` scans carried:
+Five rules, one per invariant the old ``TestStatic*`` scans carried
+(plus the serve-mesh boundary):
 
 * ``private-reach`` — files in a :data:`~csat_tpu.analysis.manifests.
   BOUNDARIES` layer may not touch ``obj._name`` on a non-``self``
@@ -9,6 +10,8 @@ Four rules, one per invariant the old ``TestStatic*`` scans carried:
   the deleted legacy Pallas kernels.
 * ``backend-literal`` — ``models/`` has no backend string constants
   outside docstrings; ``flex_core.select_impl`` is the single dispatch.
+* ``mesh-axis-literal`` — ``models/`` and ``serve/`` have no mesh axis
+  name string constants; ``parallel/mesh.py`` owns the axis spelling.
 * ``injector-ctor-kwargs`` — chaos compiles onto the
   :class:`FaultInjector` ctor's PUBLIC hook kwargs only (checked against
   the ctor's own AST, no import needed).
@@ -23,7 +26,8 @@ from csat_tpu.analysis.core import Finding, Repo, rule
 from csat_tpu.analysis.manifests import (
     BACKEND_LITERAL_SCOPE, BACKEND_LITERALS, BOUNDARIES,
     INJECTOR_CALL_FILES, INJECTOR_CLASS_FILE, INJECTOR_CLASS_NAME,
-    LEGACY_IMPORT_SCOPE, LEGACY_KERNELS)
+    LEGACY_IMPORT_SCOPE, LEGACY_KERNELS, MESH_AXIS_LITERAL_SCOPE,
+    MESH_AXIS_LITERALS)
 from csat_tpu.analysis.visitors import docstring_constants
 
 
@@ -86,6 +90,25 @@ def check_backend_literals(repo: Repo) -> Iterator[Finding]:
                     ctx.rel, node.lineno, "backend-literal",
                     f"backend literal {node.value!r} outside a docstring "
                     "— dispatch through flex_core.select_impl")
+
+
+@rule("mesh-axis-literal",
+      "models/ and serve/ may not spell mesh axis names as string "
+      "literals; parallel/mesh.py constants are the one spelling")
+def check_mesh_axis_literals(repo: Repo) -> Iterator[Finding]:
+    for ctx in repo.files():
+        if not ctx.rel.startswith(MESH_AXIS_LITERAL_SCOPE):
+            continue
+        docs = docstring_constants(ctx.tree)
+        for node in ast.walk(ctx.tree):
+            if (isinstance(node, ast.Constant)
+                    and node.value in MESH_AXIS_LITERALS
+                    and id(node) not in docs):
+                yield Finding(
+                    ctx.rel, node.lineno, "mesh-axis-literal",
+                    f"mesh axis literal {node.value!r} outside a docstring "
+                    "— use the parallel/mesh.py axis constants "
+                    "(DATA_AXIS, HEAD_AXIS, ...) and constrain helpers")
 
 
 def injector_ctor_params(repo: Repo) -> Optional[Tuple[str, ...]]:

@@ -82,6 +82,15 @@ LEGACY_IMPORT_SCOPE: Tuple[str, ...] = ("csat_tpu/", "tools/")
 BACKEND_LITERAL_SCOPE = "csat_tpu/models/"
 BACKEND_LITERALS = frozenset({"pallas"})
 
+#: Mesh axis names live in ``parallel/mesh.py`` ONLY (``DATA_AXIS`` etc.):
+#: a bare axis-name string constant in ``models/`` or ``serve/`` couples
+#: model/serving code to one mesh spelling and silently breaks when the
+#: serve mesh is renamed or re-shaped.  Sharding always goes through the
+#: mesh module's constants and ``constrain*`` helpers.
+MESH_AXIS_LITERAL_SCOPE: Tuple[str, ...] = (
+    "csat_tpu/models/", "csat_tpu/serve/")
+MESH_AXIS_LITERALS = frozenset({"data", "model", "seq", "pipe"})
+
 #: Public-ctor-kwarg check: ``FaultPlan.apply`` (and anything else in the
 #: call files) must construct :class:`FaultInjector` with keyword
 #: arguments that exist on the ctor — the hook surface is the contract.

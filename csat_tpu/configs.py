@@ -297,6 +297,18 @@ class Config:
     # disk-tier directory; "" = <output_dir>/kv_tiers. An unwritable
     # directory disables the disk tier (host-only ladder), never serving
     serve_tier_dir: str = ""
+    # --- mesh-sharded serving (ISSUE 17: one replica spanning chips) ---
+    # serve mesh shape as plain axis SIZES, (data, head) — e.g. (1, 2)
+    # places one engine's paged K/V page arrays over 2 chips sharded on
+    # the head axis, with page tables, the allocator, the prefix cache
+    # and all host-side scheduling replicated and byte-unchanged. () or
+    # all-ones = single-device (the solo path, untouched). Axis NAMES
+    # deliberately never appear here: they live in parallel/mesh.py only
+    # (the mesh-axis-literal lint rule). Rung (1) head-shards one
+    # replica, so the leading data axis must be 1; requires the paged
+    # layout, and num_heads % head_shards == 0 plus the device count are
+    # checked at engine build where devices are known.
+    serve_mesh_shape: Tuple[int, ...] = ()
     # autoscaler band (serve/autoscale.py): heal/scale between these
     # bounds. serve_max_replicas 0 = use serve_replicas as the ceiling
     serve_min_replicas: int = 1
@@ -583,6 +595,27 @@ class Config:
             assert self.serve_prefix_cache > 0, (
                 "serve_tiering requires a prefix cache "
                 "(serve_prefix_cache > 0)")
+        assert len(self.serve_mesh_shape) <= 2, (
+            f"serve_mesh_shape {self.serve_mesh_shape}: at most "
+            "(data, head) axis sizes")
+        assert all(s >= 1 for s in self.serve_mesh_shape), (
+            self.serve_mesh_shape)
+        mesh_devs = 1
+        for s in self.serve_mesh_shape:
+            mesh_devs *= s
+        if mesh_devs > 1:
+            # rung (1) shards ONE replica on the head axis; a data axis
+            # >1 is rung (2+) territory (disaggregated tiers / data-
+            # parallel decode) and would silently replicate work today
+            if len(self.serve_mesh_shape) == 2:
+                assert self.serve_mesh_shape[0] == 1, (
+                    f"serve_mesh_shape {self.serve_mesh_shape}: the "
+                    "leading (data) axis must be 1 at rung (1) — only "
+                    "the head axis shards")
+            assert self.serve_kv_layout == "paged", (
+                "serve_mesh_shape spanning >1 device requires "
+                "serve_kv_layout='paged' (page arrays shard on the head "
+                "axis; the rect pool has no sharded layout)")
         assert self.serve_min_replicas >= 1, self.serve_min_replicas
         assert self.serve_max_replicas >= 0, self.serve_max_replicas
         if self.serve_max_replicas:
@@ -835,6 +868,8 @@ def config_from_dict(d: dict) -> Config:
             kw[lens] = tuple(int(v) for v in kw[lens])
     if "mesh_shape" in kw:
         kw["mesh_shape"] = tuple((str(n), int(s)) for n, s in kw["mesh_shape"])
+    if "serve_mesh_shape" in kw:
+        kw["serve_mesh_shape"] = tuple(int(s) for s in kw["serve_mesh_shape"])
     cfg = Config(**kw)
     cfg.validate()
     return cfg

@@ -205,12 +205,28 @@ class MultiHeadAttention(nn.Module):
         kv: Optional[Dict[str, jnp.ndarray]] = None,  # precomputed project_kv output
     ) -> Tuple[jnp.ndarray, Optional[Dict[str, jnp.ndarray]]]:
         dh = self.d_model // self.num_heads
+        # head-sharded serving (ISSUE 17): the paged decode/prefill
+        # builders stamp a "shard_heads" marker into the cache/kv dicts
+        # they construct, and ONLY then do we pin activations to the head
+        # mesh axis — training, eval decode and solo serving trace the
+        # byte-identical unannotated graph. Per-head math stays chip-local
+        # with solo op order; the single collective is the replicate of
+        # the merged head outputs before the (replicated) out projection,
+        # so logits — hence tokens — are bit-identical to a solo engine.
+        shard = bool(
+            (cache is not None and cache.get("shard_heads"))
+            or (kv is not None and kv.get("shard_heads")))
+        if shard:
+            from csat_tpu.parallel.mesh import (
+                constrain_heads, constrain_replicated)
         q = split_heads(self.q_proj(q_in), self.num_heads)
         if kv is not None:
             k, v = kv["k"], kv["v"]
         else:
             k = split_heads(self.k_proj(kv_in), self.num_heads)
             v = split_heads(self.v_proj(kv_in), self.num_heads)
+        if shard:
+            q, k, v = constrain_heads(q), constrain_heads(k), constrain_heads(v)
 
         if cache is not None:
             # cache: {"k": (B,H,T,dh), "v": (B,H,T,dh), "idx": () | (B,)} —
@@ -247,12 +263,21 @@ class MultiHeadAttention(nn.Module):
                 cache = {"k_step": k_tok, "v_step": v_tok}
             else:
                 cache = {"k": k, "v": v, "idx": idx + q_in.shape[1]}
+            if shard:
+                k, v = constrain_heads(k), constrain_heads(v)
 
         scores = jnp.einsum("bhqd,bhkd->bhqk", q.astype(jnp.float32), k.astype(jnp.float32))
         scores = scores / math.sqrt(dh)
+        if shard:
+            scores = constrain_heads(scores)
         attn = masked_softmax(scores, mask)
         attn = self.attn_drop(attn, deterministic=deterministic)
         out = jnp.einsum("bhqk,bhkd->bhqd", attn, v.astype(jnp.float32))
+        if shard:
+            # the ONE collective: all-gather the per-head outputs so the
+            # merged (B, Tq, D) activation — and everything after it — is
+            # replicated, with no cross-chip reduction anywhere
+            out = constrain_replicated(out)
         out = self.out_proj(merge_heads(out).astype(self.dtype))
         return out, cache
 

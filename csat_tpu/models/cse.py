@@ -159,13 +159,13 @@ class CSE(nn.Module):
         t_q = self.param("T_q", XAVIER, (cfg.max_src_len, cfg.pegen_dim))
         rel_tables = jnp.stack([l_q, t_q]).astype(self.dtype)
 
-        from csat_tpu.parallel.mesh import constrain
+        from csat_tpu.parallel.mesh import DATA_AXIS, SEQ_AXIS, constrain
 
-        x = constrain(src_pe_emb, "data", "seq", None)
+        x = constrain(src_pe_emb, DATA_AXIS, SEQ_AXIS, None)
         layer_cls = nn.remat(CSELayer, static_argnums=(5,)) if cfg.remat else CSELayer
         for i in range(cfg.num_layers):
             x = layer_cls(cfg, self.dtype, name=f"layer_{i}")(
                 x, rel_tables, rel, mask, deterministic
             )
-            x = constrain(x, "data", "seq", None)
+            x = constrain(x, DATA_AXIS, SEQ_AXIS, None)
         return nn.LayerNorm(epsilon=LN_EPS, dtype=self.dtype)(x)

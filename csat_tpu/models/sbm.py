@@ -325,9 +325,9 @@ class SBMEncoder(nn.Module):
 
         # sequence-parallel long-AST sharding: node axis on the mesh's `seq`
         # axis (no-op outside a seq mesh) — see csat_tpu/parallel/mesh.py
-        from csat_tpu.parallel.mesh import constrain
+        from csat_tpu.parallel.mesh import DATA_AXIS, SEQ_AXIS, constrain
 
-        x = constrain(x, "data", "seq", None)
+        x = constrain(x, DATA_AXIS, SEQ_AXIS, None)
         sparsities: List[jnp.ndarray] = []
         graphs, attns = [], []
         # GPipe pipeline parallelism over a `pipe` mesh axis: the homogeneous
@@ -364,7 +364,7 @@ class SBMEncoder(nn.Module):
                 x, sparsity, graph, attn = block_cls(cfg, i, self.dtype, name=f"transformer_{i}")(
                     x, key_pad, deterministic, collect_aux
                 )
-                x = constrain(x, "data", "seq", None)
+                x = constrain(x, DATA_AXIS, SEQ_AXIS, None)
                 sparsities.append(sparsity)
                 if collect_aux:
                     graphs.append(graph)

@@ -40,14 +40,35 @@ from csat_tpu.data.dataset import Batch
 
 __all__ = [
     "build_mesh",
+    "build_serve_mesh",
     "batch_sharding",
     "batch_shardings",
     "constrain",
+    "constrain_heads",
+    "constrain_replicated",
+    "mesh_descriptor",
     "param_sharding",
     "replicated",
+    "serve_head_shards",
+    "serve_page_sharding",
+    "serve_pool_shardings",
     "shard_batch",
+    "DATA_AXIS",
+    "HEAD_AXIS",
+    "PIPE_AXIS",
+    "SEQ_AXIS",
     "PARAM_RULES",
 ]
+
+# The repo's mesh axis vocabulary. Model/serve code imports these
+# instead of spelling the strings — the ``mesh-axis-literal`` lint rule
+# (csat_tpu/analysis/manifests.py) keeps the raw names out of
+# ``models/`` and ``serve/`` so this module stays the single place an
+# axis can be renamed or re-mapped.
+DATA_AXIS = "data"
+HEAD_AXIS = "model"  # tensor parallelism: attention heads / FFN hidden
+SEQ_AXIS = "seq"
+PIPE_AXIS = "pipe"
 
 
 def build_mesh(
@@ -156,3 +177,104 @@ def constrain(x: jax.Array, *axes) -> jax.Array:
         return x
     spec = P(*[a if a in mesh.axis_names else None for a in axes])
     return jax.lax.with_sharding_constraint(x, spec)
+
+
+# ---------------------------------------------------------------------------
+# Serve mesh (ISSUE 17): one engine replica spanning chips.
+#
+# The paged-KV serving layout shards exactly ONE thing — the per-layer
+# page arrays ``(NP, H, page, dh)`` — on the head axis.  Page tables,
+# slot status, token streams, the allocator and every host-side
+# scheduling structure replicate, so the engine's control plane is
+# byte-identical to the solo path and the per-tick program is a single
+# multi-chip dispatch (page gathers index the UNsharded page axis 0 and
+# are purely local per head-shard).
+# ---------------------------------------------------------------------------
+
+
+def build_serve_mesh(
+    shape: Sequence[int],
+    devices: Optional[Sequence[jax.Device]] = None,
+) -> Mesh:
+    """Serve mesh from plain axis sizes: ``(h,)`` → a head axis only,
+    ``(d, h)`` → (data, head). Config stays name-free
+    (``serve_mesh_shape``); this is where the sizes meet the axis
+    vocabulary above."""
+    sizes = tuple(int(s) for s in shape)
+    if not sizes:
+        sizes = (1,)
+    names = (HEAD_AXIS,) if len(sizes) == 1 else (DATA_AXIS, HEAD_AXIS)
+    devices = list(devices if devices is not None else jax.devices())
+    total = int(np.prod(sizes))
+    if total > len(devices):
+        raise ValueError(
+            f"serve mesh {sizes} needs {total} devices, "
+            f"have {len(devices)}")
+    arr = np.asarray(devices[:total]).reshape(sizes)
+    return Mesh(arr, axis_names=names)
+
+
+def serve_head_shards(mesh: Mesh) -> int:
+    """Head-axis size of a serve mesh (1 = effectively solo)."""
+    return int(mesh.shape.get(HEAD_AXIS, 1))
+
+
+def constrain_heads(x: jax.Array, axis: int = 1) -> jax.Array:
+    """Constrain ``axis`` (the head dim of a ``(B, H, ...)`` activation
+    or a ``(NP, H, page, dh)`` page array) onto the head mesh axis;
+    identity outside a head-sharded ambient mesh."""
+    from csat_tpu.utils.compat import ambient_mesh
+
+    mesh = ambient_mesh()
+    if mesh is None or int(mesh.shape.get(HEAD_AXIS, 1)) == 1:
+        return x
+    spec = [None] * x.ndim
+    spec[axis] = HEAD_AXIS
+    return jax.lax.with_sharding_constraint(x, P(*spec))
+
+
+def constrain_replicated(x: jax.Array) -> jax.Array:
+    """Constrain to fully replicated under the ambient mesh (the one
+    all-gather in the head-sharded attention: merged head outputs are
+    replicated BEFORE the replicated out-projection, so every chip
+    computes bit-identical logits); identity outside a mesh."""
+    from csat_tpu.utils.compat import ambient_mesh
+
+    mesh = ambient_mesh()
+    if mesh is None or not mesh.axis_names:
+        return x
+    return jax.lax.with_sharding_constraint(x, P())
+
+
+def serve_page_sharding(mesh: Mesh) -> NamedSharding:
+    """Sharding for one per-layer page array ``(NP, H, page, dh)``:
+    heads split, page axis replicated (gathers stay chip-local)."""
+    return NamedSharding(mesh, P(None, HEAD_AXIS, None, None))
+
+
+def serve_pool_shardings(pool: Any, mesh: Mesh) -> Any:
+    """Sharding pytree shaped like a :class:`~csat_tpu.serve.pages.
+    PagedPool`: page arrays head-sharded, every other leaf (page
+    tables, status, token stream, masks) replicated. Passed as jit
+    in/out shardings — donated pool in ≡ out, so buffer aliasing
+    survives the mesh."""
+    rep = NamedSharding(mesh, P())
+    page = serve_page_sharding(mesh)
+    shardings = jax.tree.map(lambda _: rep, pool)
+    return shardings._replace(
+        pages=jax.tree.map(lambda _: page, pool.pages))
+
+
+def mesh_descriptor(mesh: Optional[Mesh]) -> str:
+    """Stable topology digest material for the warm-start key: axis
+    names, axis sizes and device kinds. A solo engine passes
+    ``mesh=None`` and gets a distinct prefix — a sharded executable can
+    never be served to a single-device engine (or vice versa) just
+    because both ran on a 1-process host."""
+    if mesh is None:
+        devs = jax.devices()
+        kinds = sorted({d.device_kind for d in devs})
+        return f"solo/{'+'.join(kinds)}"
+    axes = ",".join(f"{n}={int(mesh.shape[n])}" for n in mesh.axis_names)
+    kinds = sorted({d.device_kind for d in np.asarray(mesh.devices).flat})
+    return f"mesh[{axes}]/{'+'.join(kinds)}"

@@ -85,6 +85,13 @@ def _parser() -> argparse.ArgumentParser:
     p.add_argument("--tier_dir", default="",
                    help="disk-tier directory (default: "
                         "<output_dir>/kv_tiers)")
+    p.add_argument("--mesh", default="",
+                   help="serve-mesh shape for ONE multi-chip engine replica "
+                        "(parallel/mesh.py): 'H' or 'DxH' chip counts, e.g. "
+                        "--mesh 4 or --mesh 1x4 — KV pages and attention "
+                        "shard across H on the head axis, everything else "
+                        "is replicated; requires --kv_layout paged "
+                        "(default: config serve_mesh_shape, i.e. solo)")
     p.add_argument("--kv_layout", default="",
                    help="paged | rect KV-cache layout (default: config "
                         "serve_kv_layout)")
@@ -179,6 +186,13 @@ def build_engine(args):
         overrides["serve_queue_policy"] = args.queue_policy
     if getattr(args, "deadline_s", -1.0) >= 0:
         overrides["serve_deadline_s"] = args.deadline_s
+    if getattr(args, "mesh", ""):
+        try:
+            shape = tuple(int(s) for s in args.mesh.lower().split("x"))
+        except ValueError:
+            raise SystemExit(
+                f"--mesh wants 'H' or 'DxH' chip counts, got {args.mesh!r}")
+        overrides["serve_mesh_shape"] = shape
     if getattr(args, "kv_layout", ""):
         overrides["serve_kv_layout"] = args.kv_layout
     if getattr(args, "page_size", 0):

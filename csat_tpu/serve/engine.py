@@ -58,6 +58,7 @@ import math
 import os
 import time
 from collections import defaultdict, deque
+from contextlib import nullcontext
 from typing import Any, Callable, Deque, Dict, List, Optional, Sequence, Set
 
 import jax
@@ -68,6 +69,13 @@ from csat_tpu.configs import Config
 from csat_tpu.data.vocab import Vocab
 from csat_tpu.models import CSATrans
 from csat_tpu.obs import EventRecorder, Tracer
+from csat_tpu.parallel.mesh import (
+    build_serve_mesh,
+    mesh_descriptor,
+    replicated,
+    serve_head_shards,
+    serve_pool_shardings,
+)
 from csat_tpu.resilience.retry import ErrorBudget
 from csat_tpu.resilience.watchdog import StepWatchdog
 from csat_tpu.serve.ingest import PoisonRequestError, validate_sample
@@ -255,6 +263,28 @@ class ServeEngine:
         # KV layout: block-paged pool (serve/pages.py) or the PR-3 per-slot
         # rectangles — bit-identical outputs, radically different memory
         self.paged = cfg.serve_kv_layout == "paged"
+        # serve mesh (ISSUE 17): serve_mesh_shape spanning >1 device puts
+        # this ONE engine across chips — page arrays sharded on the head
+        # axis, params and every other pool leaf replicated, all host-side
+        # scheduling (allocator, page tables, prefix cache, queue)
+        # byte-unchanged.  cfg.validate() already pinned the paged layout
+        # and a unit data axis; device count and head divisibility are
+        # only checkable here
+        self.mesh = None
+        self._pool_sh = self._rep_sh = None
+        mesh_devs = 1
+        for _s in cfg.serve_mesh_shape:
+            mesh_devs *= int(_s)
+        if mesh_devs > 1:
+            self.mesh = build_serve_mesh(cfg.serve_mesh_shape)
+            hs = serve_head_shards(self.mesh)
+            if cfg.num_heads % hs:
+                raise ValueError(
+                    f"serve_mesh_shape={cfg.serve_mesh_shape}: num_heads="
+                    f"{cfg.num_heads} must divide evenly over {hs} head "
+                    "shards")
+            self._rep_sh = replicated(self.mesh)
+        self.stats.mesh_devices = mesh_devs
         if self.paged:
             self.geo = page_geometry(cfg)
             self._allocator = PageAllocator(self.geo.num_pages)
@@ -263,6 +293,12 @@ class ServeEngine:
                 if cfg.serve_prefix_cache > 0 else None)
             self._pool = init_paged_pool(
                 model, {"params": params}, self.num_slots, self.geo)
+            if self.mesh is not None:
+                # the engine's long-lived device state goes under explicit
+                # NamedShardings up front; every compiled program below
+                # pins the same layout in/out, so no tick ever re-shards
+                self._pool_sh = serve_pool_shardings(self._pool, self.mesh)
+                self._pool = jax.device_put(self._pool, self._pool_sh)
         else:
             self.geo = None
             self._allocator = None
@@ -305,7 +341,8 @@ class ServeEngine:
         # per occupied bucket would duplicate the whole parameter set
         # several times over in device memory, eroding exactly the KV
         # headroom the paged pool exists to create
-        self._dparams = jax.device_put(params)
+        self._dparams = (jax.device_put(params, self._rep_sh)
+                         if self.mesh is not None else jax.device_put(params))
 
         # warm-start executable store (serve/warmstart.py, ISSUE 13): a
         # caller-shared store (the fleet hands every replica the same one)
@@ -318,9 +355,13 @@ class ServeEngine:
             if cfg.serve_warmstart else None)
         self._ws_fields: Dict[str, Any] = {}
         if self.warmstart is not None and self.warmstart.enabled:
-            devs = jax.devices()
+            # topology key: axis names/sizes + device kinds (or a distinct
+            # solo prefix) — NOT a bare device count, which collapses every
+            # topology on a 1-process host and would serve a sharded
+            # executable to a solo engine (satellite fix, ISSUE 17; the
+            # store also re-checks this field at load → "mesh_mismatch")
             self._ws_fields = {
-                "mesh": f"{len(devs)}x{devs[0].platform}",
+                "mesh": mesh_descriptor(self.mesh),
                 "git": git_rev(),
                 "params": params_digest(params),
                 "layout": cfg.serve_kv_layout,
@@ -334,11 +375,20 @@ class ServeEngine:
             }
 
         # the ONE decode-step program, AOT-compiled up front (pool donated:
-        # slot state advances in place, no per-step copies)
-        step_fn = (build_paged_decode_step(model, self.geo) if self.paged
-                   else build_decode_step(model))
+        # slot state advances in place, no per-step copies).  Under a
+        # serve mesh the step is built with head-sharding markers and
+        # compiled with explicit in/out shardings — pool in ≡ pool out
+        # (donation aliases across chips), status replicated (ONE cheap
+        # host fetch, no host-side gather) — so each tick stays a single
+        # multi-chip dispatch
+        step_fn = (build_paged_decode_step(
+            model, self.geo, shard_heads=self.mesh is not None)
+            if self.paged else build_decode_step(model))
         step = jax.jit(lambda pool: step_fn(self._dparams, pool),
-                       donate_argnums=(0,))
+                       donate_argnums=(0,),
+                       **(dict(in_shardings=(self._pool_sh,),
+                               out_shardings=(self._pool_sh, self._rep_sh))
+                          if self.mesh is not None else {}))
         self._decode_prog = self._aot_compile("decode", step, (self._pool,),
                                               (0,))
         self.stats.record_compile("decode", (self.num_slots, self.steps))
@@ -352,7 +402,7 @@ class ServeEngine:
         self._freeze_prog = jax.jit(
             lambda pool, keep: pool._replace(
                 limit=jnp.where(keep, pool.limit, 0)),
-            donate_argnums=(0,))
+            donate_argnums=(0,), **self._mesh_jit_kw(1))
         if self.paged:
             # retire surgery: zero the budget AND null the page-table rows
             # so a freed page handed to another request cannot be written
@@ -360,7 +410,8 @@ class ServeEngine:
             # its first caller mid-traffic is a timeout/shed/reap/NaN
             # retirement, and a lazy compile there would stall the tick
             # loop while every in-flight deadline clock keeps running
-            fn = jax.jit(build_release(), donate_argnums=(0,))
+            fn = jax.jit(build_release(), donate_argnums=(0,),
+                         **self._mesh_jit_kw(1))
             self._release_prog = self._aot_compile(
                 "release", fn,
                 (self._pool, np.ones((self.num_slots,), bool)), (0,))
@@ -373,7 +424,7 @@ class ServeEngine:
             # program, AOT-compiled HERE so a first hit mid-traffic cannot
             # trip the steady-state zero-recompile tripwire
             fn = jax.jit(build_attach(),
-                         donate_argnums=(0,))
+                         donate_argnums=(0,), **self._mesh_jit_kw(5))
             self._attach_prog = self._aot_compile("attach", fn, (
                 self._pool,
                 np.full((self.num_slots,), self.num_slots, np.int32),
@@ -406,13 +457,20 @@ class ServeEngine:
             self._tier_shape = (len(layers), 2, self.geo.cp) + tuple(
                 probe.shape[1:])
             self._tier_dtype = np.dtype(probe.dtype)
-            fn = jax.jit(build_tier_gather())
+            # spill/restore cross the mesh boundary device-side: the ONE
+            # gather program emits the snapshot replicated (out_shardings
+            # below — an all-gather on the mesh, a no-op solo), so the
+            # host reads whole-chain bytes from one device and the tier
+            # store/digest format stays layout- and mesh-oblivious
+            fn = jax.jit(build_tier_gather(),
+                         **self._mesh_jit_kw(1, out="rep"))
             self._tier_gather_prog = self._aot_compile(
                 "tier_gather", fn,
                 (self._pool, np.full((self.geo.cp,), NULL_PAGE, np.int32)),
                 ())
             self.stats.record_compile("tier_gather", (self.geo.cp,))
-            fn = jax.jit(build_tier_restore(), donate_argnums=(0,))
+            fn = jax.jit(build_tier_restore(), donate_argnums=(0,),
+                         **self._mesh_jit_kw(2))
             self._tier_restore_prog = self._aot_compile(
                 "tier_restore", fn,
                 (self._pool,
@@ -463,15 +521,40 @@ class ServeEngine:
         self._flush_postmortems(force=True)
         return True
 
+    def _mesh_jit_kw(self, n_aux: int, out: str = "pool") -> Dict[str, Any]:
+        """Explicit jit sharding kwargs for a serving program whose
+        positional args are ``(pool, *aux)`` with every aux operand
+        replicated (host-built id/limit/mask/payload arrays): pool in ≡
+        pool out — donation aliases buffers shard-for-shard — and
+        ``out="rep"`` for programs whose output the host reads whole (the
+        tier gather).  Empty solo, so every jit call site below stays a
+        plain jit off-mesh."""
+        if self.mesh is None:
+            return {}
+        ins = (self._pool_sh,) + (self._rep_sh,) * n_aux
+        return {"in_shardings": ins,
+                "out_shardings": self._pool_sh if out == "pool"
+                else self._rep_sh}
+
     def _aot_compile(self, program: str, jit_fn: Any, args: Sequence[Any],
                      donate: Sequence[int]) -> Any:
         """AOT-compile one serving program through the warm-start store
         (plain ``lower().compile()`` when the store is off) and book the
         warm/cold provenance.  Store failures degrade, never raise — a
-        replacement replica must come up on a corrupt store."""
-        prog, provenance = warm_compile(
-            self.warmstart, program, jit_fn, tuple(args), tuple(donate),
-            dict(self._ws_fields), obs=self.obs, log=self.log)
+        replacement replica must come up on a corrupt store.
+
+        Under a serve mesh the trace runs inside ``use_mesh``: the
+        head-sharding constraints in the model (``constrain_heads`` /
+        ``constrain_replicated``) read the ambient mesh at trace time."""
+        if self.mesh is not None:
+            from csat_tpu.utils.compat import use_mesh
+            cm = use_mesh(self.mesh)
+        else:
+            cm = nullcontext()
+        with cm:
+            prog, provenance = warm_compile(
+                self.warmstart, program, jit_fn, tuple(args), tuple(donate),
+                dict(self._ws_fields), obs=self.obs, log=self.log)
         if provenance == "warm":
             self.stats.warmstart_hits += 1
         elif self.warmstart is not None and self.warmstart.enabled:
@@ -1487,13 +1570,19 @@ class ServeEngine:
                 pf = build_paged_prefill(self.model, spec, geo)
                 # params explicit + in-program sample key, as in the rect
                 # path
+                # under a mesh, out_shardings pins the written pool back
+                # to the canonical layout (in-shardings are inferred from
+                # the committed _dparams/pool — the PRNG key operand has
+                # no NamedSharding form to spell explicitly)
                 fn = jax.jit(
                     lambda params, batch, ids, limits, self_rows,
                            cross_chain, ordinal, pool: pf(
                         params, batch, ids, limits, self_rows,
                         cross_chain,
                         jax.random.fold_in(self._base_key, ordinal), pool),
-                    donate_argnums=(7,))
+                    donate_argnums=(7,),
+                    **({"out_shardings": self._pool_sh}
+                       if self.mesh is not None else {}))
                 t0 = time.perf_counter()
                 prog = self._aot_compile(
                     f"prefill_n{spec.n}b{spec.batch_size}", fn,
@@ -1634,6 +1723,10 @@ class ServeEngine:
                 self._stamp_tier_stats()
             self._pool = init_paged_pool(
                 self.model, {"params": self.params}, self.num_slots, self.geo)
+            if self.mesh is not None:
+                # rebuilt state goes straight back under the canonical
+                # shardings — the carried-over mesh programs require it
+                self._pool = jax.device_put(self._pool, self._pool_sh)
         else:
             self._pool = init_pool(
                 self.model, {"params": self.params}, self.num_slots,

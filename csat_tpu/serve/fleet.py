@@ -136,10 +136,20 @@ class Fleet:
         clock: Callable[[], float] = time.monotonic,
         sample_seed: int = 0,
         log: Callable[[str], None] = lambda m: None,
+        mesh_shapes: Optional[Sequence[Sequence[int]]] = None,
     ):
         n = replicas or cfg.serve_replicas
         assert n >= 1, n
         self.cfg = cfg
+        # per-replica serve-mesh override (ISSUE 17): replica k gets
+        # mesh_shapes[k] as its serve_mesh_shape (entries beyond the list
+        # inherit cfg) — a fleet can mix solo and mesh-sharded members,
+        # and every fleet behavior (routing, retirement, resubmission,
+        # chaos) treats them identically because a sharded engine is
+        # exactly engine-shaped
+        self._mesh_shapes = (None if mesh_shapes is None
+                             else [tuple(int(x) for x in s)
+                                   for s in mesh_shapes])
         self.clock = clock
         self.log = log
         self.router = Router()
@@ -436,6 +446,9 @@ class Fleet:
         if self._postmortem_dir:
             cfg = cfg.replace(obs_postmortem_dir=os.path.join(
                 self._postmortem_dir, f"replica{k}"))
+        if self._mesh_shapes is not None and k < len(self._mesh_shapes):
+            cfg = cfg.replace(serve_mesh_shape=self._mesh_shapes[k])
+            cfg.validate()
         rep = Replica(index=k, engine=None, health=DRAINING)
 
         def on_timeout(rep: Replica = rep) -> None:

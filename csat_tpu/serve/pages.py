@@ -231,7 +231,8 @@ def gather_chain(pages: jnp.ndarray, table: jnp.ndarray, width: int) -> jnp.ndar
     return g[:, :, :width, :]
 
 
-def build_paged_decode_step(model: CSATrans, geo: PageGeometry):
+def build_paged_decode_step(model: CSATrans, geo: PageGeometry,
+                            shard_heads: bool = False):
     """→ ``step(params, pool) -> (pool, status)``: advance every live slot
     one token, reading K/V through each row's page chain.  Pure and
     shape-stable — the engine AOT-compiles it exactly once (donating the
@@ -243,25 +244,43 @@ def build_paged_decode_step(model: CSATrans, geo: PageGeometry):
     The per-token K/V write targets page ``self_pt[s, pos // page]`` at
     offset ``pos % page``; frozen rows (and rows whose tables were nulled
     at retire) are routed to the null page, so a freed page can be handed
-    to another request the same tick without corruption."""
+    to another request the same tick without corruption.
+
+    ``shard_heads`` (the serve-mesh path, ISSUE 17) stamps a marker into
+    the cache dicts so :class:`~csat_tpu.models.components.
+    MultiHeadAttention` pins q/k/v/scores to the head mesh axis and
+    replicates the merged output before ``out_proj`` — per-head math is
+    chip-local and op-order-identical to solo, so tokens stay
+    bit-identical.  The page gather indexes the UNsharded page axis 0,
+    so gathers/scatters never cross chips either.  False (default) emits
+    byte-identical programs to the pre-mesh builder."""
     page = geo.page
 
     def step(params, pool: PagedPool):
+        if shard_heads:
+            from csat_tpu.parallel.mesh import constrain_heads as ch
+        else:
+            def ch(x):
+                return x
+
         s = pool.pos.shape[0]
         cache = {}
         for layer, entry in pool.pages.items():
             cache[layer] = {
                 "self": {
-                    "k": gather_chain(entry["k"], pool.self_pt, geo.steps),
-                    "v": gather_chain(entry["v"], pool.self_pt, geo.steps),
+                    "k": ch(gather_chain(entry["k"], pool.self_pt, geo.steps)),
+                    "v": ch(gather_chain(entry["v"], pool.self_pt, geo.steps)),
                     "idx": pool.pos,
                     "paged": True,  # components.py: emit k_step/v_step only
                 },
                 "cross": {
-                    "k": gather_chain(entry["k"], pool.cross_pt, geo.mem_len),
-                    "v": gather_chain(entry["v"], pool.cross_pt, geo.mem_len),
+                    "k": ch(gather_chain(entry["k"], pool.cross_pt, geo.mem_len)),
+                    "v": ch(gather_chain(entry["v"], pool.cross_pt, geo.mem_len)),
                 },
             }
+            if shard_heads:
+                cache[layer]["self"]["shard_heads"] = True
+                cache[layer]["cross"]["shard_heads"] = True
         log_probs, new_cache = model.apply(
             {"params": params}, pool.tok, pool.pos, cache, None,
             pool.src_mask, pool.prev_pad, method=CSATrans.decode_step,

@@ -115,6 +115,12 @@ _METRICS = {
                   "high-water KV pages in use"),
     "pages_in_use": ("gauge", "serve_kv_pages_in_use",
                      "KV pages in use at the last tick sample"),
+    # mesh-sharded serving (ISSUE 17)
+    "mesh_devices": ("gauge", "serve_mesh_devices",
+                     "devices the engine's serve mesh spans (1 = solo)"),
+    "pages_worst_chip": ("gauge", "serve_kv_pages_in_use_worst_chip",
+                         "worst single chip's KV page occupancy — the "
+                         "autoscaler's page-pressure signal under a mesh"),
     "queue_depth": ("gauge", "serve_queue_depth",
                     "queued (not yet admitted) requests"),
     "occupancy": ("gauge", "serve_slots_occupied",
@@ -169,6 +175,14 @@ class ServeStats:
     rect_pages_per_slot = _Backed()  # equal-memory yardstick (SP + CP)
     page_peak = _Backed()       # high-water pages in use
     pages_in_use = _Backed()    # last per-tick occupancy sample
+    # mesh-sharded serving (ISSUE 17): device span of this engine's serve
+    # mesh (1 = solo) and the worst single chip's page occupancy. At rung
+    # (1) the allocator is replicated so every chip holds the same chains
+    # (page axis unsharded) and worst-chip == pages_in_use; rung (2+)
+    # per-chip allocation will make these diverge, and the autoscaler's
+    # occupancy signal keys off the worst chip either way
+    mesh_devices = _Backed()
+    pages_worst_chip = _Backed()
     queue_depth = _Backed()     # scrape-surface mirrors (engine-stamped)
     occupancy = _Backed()
     # warm-start provenance (serve/warmstart.py): hits deserialize a stored
@@ -245,10 +259,14 @@ class ServeStats:
         self.pages_usable = int(usable)
         self.rect_pages_per_slot = int(rect_pages_per_slot)
 
-    def note_pages(self, used: int) -> None:
-        """One per-tick occupancy sample (pages currently allocated)."""
+    def note_pages(self, used: int, worst_chip: Optional[int] = None) -> None:
+        """One per-tick occupancy sample (pages currently allocated).
+        ``worst_chip`` is the heaviest single chip's page count under a
+        serve mesh; it defaults to ``used`` (solo, or the rung-1 mesh
+        where the replicated allocator keeps every chip uniform)."""
         used = int(used)
         self.pages_in_use = used
+        self.pages_worst_chip = int(used if worst_chip is None else worst_chip)
         if used > self.page_peak:
             self.page_peak = used
         self._page_sum += used
@@ -355,6 +373,8 @@ class ServeStats:
             "kv_pages": usable,
             "kv_page_occupancy": round(occ, 4),
             "kv_page_peak": round(peak, 4),
+            "mesh_devices": max(int(self.mesh_devices), 1),
+            "kv_pages_worst_chip": self.pages_worst_chip,
             "prefix_hit_rate": round(hit_rate, 4),
             "effective_slots": round(eff, 3),
             # tier ladder (zeros when serve_tiering is off)

@@ -178,8 +178,8 @@ class WarmStartStore:
              fields: Dict[str, Any]) -> Tuple[Optional[bytes], str]:
         """→ ``(payload, "hit")`` or ``(None, miss reason)``.  The miss
         reason is one of ``disabled | absent | corrupt_header |
-        digest_mismatch | jaxlib_mismatch | io_error`` — the structured
-        ``warmstart_miss{reason}`` vocabulary."""
+        digest_mismatch | jaxlib_mismatch | mesh_mismatch | io_error`` —
+        the structured ``warmstart_miss{reason}`` vocabulary."""
         import jaxlib
 
         if self.root is None:
@@ -204,6 +204,11 @@ class WarmStartStore:
             # belt and braces: the key already includes the jaxlib version,
             # but a hand-copied or renamed entry must still be refused
             return None, "jaxlib_mismatch"
+        if "mesh" in fields and (
+                header.get("fields", {}).get("mesh") != str(fields["mesh"])):
+            # same belt and braces for the device topology: an artifact
+            # exported under one mesh must never warm-start another
+            return None, "mesh_mismatch"
         if hashlib.sha256(payload).hexdigest() != want:
             return None, "digest_mismatch"
         return payload, "hit"

@@ -133,6 +133,34 @@ CASES = {
                 """,
         },
     ),
+    "mesh-axis-literal": dict(
+        positive={
+            "csat_tpu/serve/engine.py": """
+                from jax.sharding import PartitionSpec as P
+
+                def page_spec():
+                    return P(None, "model", None, None)
+                """,
+        },
+        negative={
+            "csat_tpu/serve/engine.py": '''
+                """Pages shard on the "model" axis; names live in mesh.py."""
+                from csat_tpu.parallel.mesh import HEAD_AXIS
+                from jax.sharding import PartitionSpec as P
+
+                def page_spec():
+                    # "models" / "pipeline" CONTAIN axis names, are not ones
+                    kind = "models"
+                    stage = "pipeline"
+                    return P(None, HEAD_AXIS, None, None), kind, stage
+                ''',
+        },
+        suppressed={
+            "csat_tpu/serve/engine.py": """
+                AXES = ("data", "model")  # csat-lint: disable=mesh-axis-literal doc table of the axis vocabulary, not a sharding
+                """,
+        },
+    ),
     "injector-ctor-kwargs": dict(
         positive={
             **FAULTS_FIXTURE,

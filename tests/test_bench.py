@@ -68,14 +68,15 @@ def test_alive_tpu_best_variant_wins(bench, monkeypatch, capsys):
     out = _run_main(bench, capsys)
     assert out["device"] == "tpu"
     # the 4th variant wins: the 5th-10th (bucketed 104, serve 105, fleet
-    # 106, chaos 107, autoscale 108, tiering 109) are excluded from the
-    # headline pool — vs_baseline stays defined on the padded-credit
-    # fixed-shape protocol
+    # 106, chaos 107, autoscale 108, tiering 109) and mesh_serve (its own
+    # child group) are excluded from the headline pool — vs_baseline
+    # stays defined on the padded-credit fixed-shape protocol
     assert out["value"] == 103.0
     assert "degraded" not in out
-    assert len(out["all_variants"]) == 10
-    # one probe + ONE serve for the whole device group (single claim)
-    assert [c[0] for c in calls] == ["--probe", "--serve"]
+    assert len(out["all_variants"]) == 11
+    # one probe + ONE serve for the whole device group (single claim) +
+    # one serve for the mesh_serve spec (private 8-virtual-device child)
+    assert [c[0] for c in calls] == ["--probe", "--serve", "--serve"]
 
 
 def test_dead_probe_falls_back_to_cpu_specs(bench, monkeypatch, capsys):
@@ -381,8 +382,10 @@ def test_killed_serve_retries_untried_first(bench, monkeypatch, capsys):
             _emit(bench, _result(specs[0], 100.0))
             _emit(bench, {"phase": "start", "spec": specs[1]})
             return None, "timeout after 555s"
-        # retry round: the killed spec (2nd = pallas:f32) must be queued last
-        assert specs[-1].startswith("pallas:float32"), specs
+        if state["round"] == 3:
+            # retry round (after round 2's private mesh_serve child): the
+            # killed spec (2nd = pallas:f32) must be queued last
+            assert specs[-1].startswith("pallas:float32"), specs
         for spec in specs:
             _emit(bench, {"phase": "start", "spec": spec})
             _emit(bench, _result(spec, 300.0))
@@ -391,8 +394,8 @@ def test_killed_serve_retries_untried_first(bench, monkeypatch, capsys):
 
     monkeypatch.setattr(bench, "_run_child", fake_child)
     out = _run_main(bench, capsys)
-    assert state["round"] == 2
-    assert len(out["all_variants"]) == 10
+    assert state["round"] == 3
+    assert len(out["all_variants"]) == 11
     assert out["value"] == 300.0
     assert "killed during" not in out.get("notes", "")  # retried successfully
 
@@ -416,9 +419,9 @@ def test_deterministic_error_not_retried(bench, monkeypatch, capsys):
 
     monkeypatch.setattr(bench, "_run_child", fake_child)
     out = _run_main(bench, capsys)
-    assert state["serves"] == 1  # error is final: no retry round
+    assert state["serves"] == 2  # dev + mesh children; error is final: no retry
     assert "non-finite" in out["notes"]
-    assert len(out["all_variants"]) == 9
+    assert len(out["all_variants"]) == 10
 
 
 def test_malformed_bench_variants_flagged(bench, monkeypatch, capsys):
@@ -458,9 +461,9 @@ def test_done_record_authoritative_over_stdout_marker(bench, monkeypatch, capsys
 
     monkeypatch.setattr(bench, "_run_child", fake_child)
     out = _run_main(bench, capsys)
-    assert state["serves"] == 1  # done record suppressed the retry round
+    assert state["serves"] == 2  # dev + mesh children; no retry round
     assert "serve:" not in out.get("notes", "")
-    assert len(out["all_variants"]) == 10
+    assert len(out["all_variants"]) == 11
     assert "degraded" not in out
 
 
@@ -620,7 +623,10 @@ def test_calibration_and_metrics_embed_in_record(bench, monkeypatch, capsys):
     out = _run_main(bench, capsys)
     assert out["machine_fingerprint"]["id"] == "abc123"
     assert out["calibration"]["probes"]["matmul_f32_gflops"] == 50.0
-    assert out["bench_metrics"] == snap
+    # both serve children (dev + mesh) emit the snapshot: bytes take the
+    # max across children, compile seconds accumulate
+    assert out["bench_metrics"] == {"bench_peak_bytes": 4096,
+                                    "compile_seconds_total": 25.0}
     # first calibrated run anchors the ledger: normalized == raw
     assert out["nodes_per_sec_per_chip_cal"] == out["value"]
     assert out["calibration_ratio_vs_reference"] == 1.0

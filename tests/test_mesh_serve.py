@@ -1,0 +1,256 @@
+"""Mesh-sharded serving drills (ISSUE 17 tentpole).
+
+Pins the multi-chip replica's contracts on the 8-device virtual CPU mesh
+(``tests/conftest.py``):
+
+* **sharded bit-identity** — a ``serve_mesh_shape=(1, 2)`` engine serves a
+  mixed-length trace (cold admissions, prefix-hit replay, a forced
+  spill→restore leg) token-for-token AND terminal-status-identical to a
+  solo engine over the same model, params and sample seed.  Head-sharding
+  keeps every per-head op local and all-gathers once before ``out_proj``,
+  so there is no cross-chip reduction to reorder floats;
+* **zero steady-state compiles** — after bring-up the mesh engine's
+  ``compiles`` counter is flat across fresh traffic: one program per
+  bucket, sharded or not;
+* **engine-shaped** — the mesh engine exposes the same stats surface
+  (``mesh_devices`` / worst-chip page gauges) and the same leak
+  invariants as a solo engine;
+* **warm-start keying** — the mesh descriptor distinguishes device
+  topologies (the pre-PR-17 ``NxPLATFORM`` key collapsed them on any
+  1-process host) and a hand-copied artifact from another mesh is refused
+  with the structured ``mesh_mismatch`` miss reason;
+* **chaos** (``-m chaos``) — ``retire_replica`` + ``spill_storm`` on a
+  2-replica fleet whose member 0 is mesh-sharded, strict invariants
+  armed: the fleet retires the solo member mid-traffic and the sharded
+  member absorbs the retried work with every request terminal and zero
+  chain/page leaks.
+"""
+
+import json
+
+import numpy as np
+import pytest
+
+from csat_tpu.data.toy import random_request_sample
+from csat_tpu.parallel.mesh import build_serve_mesh, mesh_descriptor
+from csat_tpu.resilience import (
+    FaultEvent,
+    FaultPlan,
+    InvariantMonitor,
+    run_chaos,
+)
+from csat_tpu.serve import (
+    Fleet,
+    RequestStatus,
+    ServeEngine,
+    collate_requests,
+    make_trace,
+    zoo_spec,
+)
+from csat_tpu.serve.warmstart import WarmStartStore
+
+SRC_V, TGT_V, TRIP_V = 200, 300, 50
+
+
+def _model_and_params(cfg):
+    from csat_tpu.train.state import create_train_state, default_optimizer, make_model
+
+    model = make_model(cfg, SRC_V, TGT_V, TRIP_V)
+    warm = collate_requests(
+        [random_request_sample(cfg, SRC_V, TRIP_V, 8, seed=0)],
+        cfg.max_src_len, 1, cfg, tgt_width=cfg.max_tgt_len - 1)
+    params = create_train_state(
+        model, default_optimizer(cfg), warm, seed=0).params
+    return model, params
+
+
+def _trace(cfg, n, seed):
+    rng = np.random.default_rng(seed)
+    return [
+        random_request_sample(cfg, SRC_V, TRIP_V, int(ln), seed=900 * seed + i)
+        for i, ln in enumerate(rng.integers(5, cfg.max_src_len, n))
+    ]
+
+
+def _reset(eng):
+    """Cold cache + empty tiers between drills (module-shared engines)."""
+    assert eng.occupancy == 0 and eng.queue_depth == 0
+    for _h, chain in eng._prefix.evict_for(1 << 30):
+        eng._allocator.free(chain)
+    if eng._tiers is not None:
+        eng._tiers.clear()
+
+
+@pytest.fixture(scope="module")
+def mesh_pair(micro_config, tmp_path_factory):
+    """(cfg, solo_engine, mesh_engine): one shared model/params, identical
+    configs except ``serve_mesh_shape=(1, 2)`` — the solo engine is the
+    reference for every bit-identity assertion."""
+    cfg = micro_config.replace(
+        full_att=True, dropout=0.0, attention_dropout=0.0,
+        cse_empty_rows="zero", serve_slots=4, bucket_src_lens=(48,),
+        serve_page_size=4, serve_tiering=True,
+        serve_tier_dir=str(tmp_path_factory.mktemp("mesh_tiers")))
+    model, params = _model_and_params(cfg)
+    solo = ServeEngine(model, params, cfg, sample_seed=1)
+    mesh = ServeEngine(
+        model, params, cfg.replace(serve_mesh_shape=(1, 2)), sample_seed=1)
+    yield cfg, solo, mesh
+    solo.close()
+    mesh.close()
+
+
+def test_mesh_engine_is_engine_shaped(mesh_pair):
+    """Same public surface, mesh-aware gauges: the sharded engine is a
+    drop-in ``ServeEngine`` with its topology visible in the summary."""
+    _cfg, solo, mesh = mesh_pair
+    assert solo.mesh is None and mesh.mesh is not None
+    assert dict(mesh.mesh.shape) == {"data": 1, "model": 2}
+    s_solo, s_mesh = solo.stats.summary(), mesh.stats.summary()
+    assert s_solo["mesh_devices"] == 1
+    assert s_mesh["mesh_devices"] == 2
+    # rung (1): the allocator is replicated, so worst-chip == global gauge
+    assert s_mesh["kv_pages_worst_chip"] == int(mesh.stats.pages_in_use)
+
+
+def test_sharded_bit_identity_cold_prefix_and_restore(mesh_pair):
+    """The acceptance drill: cold admissions, a prefix-hit replay, then a
+    forced spill of the mesh engine's whole warm set and a replay served
+    through tier restores — tokens and terminal statuses match the solo
+    reference at every leg."""
+    cfg, solo, mesh = mesh_pair
+    _reset(solo)
+    _reset(mesh)
+    samples = _trace(cfg, 6, seed=1)
+
+    def run(eng):
+        res = eng.generate(samples, max_new_tokens=4)
+        return ({i: np.asarray(r.tokens) for i, r in enumerate(res)},
+                [r.status for r in res])
+
+    ref, ref_st = run(solo)      # leg 1: cold
+    got, got_st = run(mesh)
+    assert got_st == ref_st and all(s == RequestStatus.OK for s in got_st)
+
+    hits0 = mesh.stats.prefix_hits
+    ref2, ref2_st = run(solo)    # leg 2: prefix-hit replay
+    got2, got2_st = run(mesh)
+    assert got2_st == ref2_st
+    assert mesh.stats.prefix_hits - hits0 >= len(samples)
+
+    spilled = mesh.spill_all()   # leg 3: spill/restore across the mesh
+    assert spilled > 0 and len(mesh._prefix) == 0
+    r0 = mesh._tiers.restores
+    got3, got3_st = run(mesh)
+    assert mesh._tiers.restores > r0 and mesh._tiers.restore_misses == 0
+    assert got3_st == ref2_st
+
+    mon = InvariantMonitor(cfg)
+    mon.check_tokens(ref, got, label="sharded_bit_identity")
+    mon.check_tokens(ref2, got2, label="sharded_bit_identity")
+    mon.check_tokens(ref2, got3, label="restore_bit_identity")
+    assert mon.violations == [], mon.violations
+    assert mesh.page_leaks() == 0 and mesh.chain_leaks() == 0
+
+
+def test_zero_steady_state_compiles_under_mesh(mesh_pair):
+    """One program per bucket survives sharding: fresh traffic after
+    bring-up must not grow the mesh engine's ``compiles`` counter."""
+    cfg, _solo, mesh = mesh_pair
+    _reset(mesh)
+    mesh.generate(_trace(cfg, 4, seed=7), max_new_tokens=3)   # warm
+    warm_compiles = int(mesh.stats.compiles)
+    res = mesh.generate(_trace(cfg, 5, seed=8), max_new_tokens=4)
+    assert all(r.status == RequestStatus.OK for r in res)
+    assert int(mesh.stats.compiles) == warm_compiles
+
+
+def test_mesh_descriptor_distinguishes_topologies():
+    """The warm-start key fix: solo and (1, 2) topologies on the SAME
+    host hash to different descriptors (the old ``NxPLATFORM`` spelling
+    collapsed them)."""
+    solo = mesh_descriptor(None)
+    sharded = mesh_descriptor(build_serve_mesh((1, 2)))
+    assert solo.startswith("solo/")
+    assert sharded.startswith("mesh[data=1,model=2]/")
+    assert solo.split("/", 1)[1] == sharded.split("/", 1)[1]  # same kinds
+
+
+def test_warmstart_refuses_foreign_mesh_artifact(tmp_path):
+    """A hand-copied entry exported under another mesh is refused with the
+    structured ``mesh_mismatch`` reason even though its digest verifies —
+    the same belt-and-braces contract as ``jaxlib_mismatch``."""
+    import hashlib
+
+    import jaxlib
+
+    store = WarmStartStore(str(tmp_path))
+    a = {"mesh": "solo/cpu", "git": "abc"}
+    b = {"mesh": "mesh[data=1,model=2]/cpu", "git": "abc"}
+    assert store.save("decode", a, b"\x01payload") is True
+    assert store.load("decode", a) == (b"\x01payload", "hit")
+
+    # forge the entry under b's path with a verifying digest but a's mesh
+    header = json.dumps({
+        "magic": "csat-warmstart-v1", "jaxlib": jaxlib.__version__,
+        "payload_sha256": hashlib.sha256(b"\x01payload").hexdigest(),
+        "fields": {k: str(v) for k, v in sorted(a.items())},
+    }).encode()
+    with open(store.path("decode", b), "wb") as f:
+        f.write(header + b"\n" + b"\x01payload")
+    assert store.load("decode", b) == (None, "mesh_mismatch")
+
+
+def test_kv_pages_table_shows_mesh_columns():
+    """The ``csat_tpu top`` / ``tools/obs_report.py`` shared renderer grows
+    chip-count and worst-chip columns exactly when a replica spans more
+    than one chip, and stays byte-compatible for solo fleets."""
+    from tools.obs_report import kv_pages_table
+
+    meshed = {"_index": 0, "serve_kv_pages": 16, "serve_kv_pages_in_use": 6,
+              "serve_kv_pages_peak": 0.5, "serve_mesh_devices": 2,
+              "serve_kv_pages_in_use_worst_chip": 6}
+    solo = {"_index": 1, "serve_kv_pages": 16, "serve_kv_pages_in_use": 3,
+            "serve_kv_pages_peak": 0.2}
+    table = kv_pages_table([meshed, solo])
+    assert "chips" in table and "worst_chip" in table
+    assert "replica0" in table and "replica1" in table
+    assert "chips" not in kv_pages_table([solo])
+
+
+@pytest.mark.slow
+@pytest.mark.chaos
+def test_chaos_retire_replica_mixed_mesh_fleet(micro_config, tmp_path_factory):
+    """A 2-replica fleet with member 0 mesh-sharded, member 1 solo, strict
+    invariants armed: a spill storm hits the sharded member mid-traffic
+    (tier snapshots crossing the mesh boundary) and then the SOLO member
+    retires — the mesh replica absorbs the retried work and the run
+    drains clean (every request terminal, no chain/page leaks)."""
+    cfg = micro_config.replace(
+        full_att=True, dropout=0.0, attention_dropout=0.0,
+        cse_empty_rows="zero", serve_slots=2, bucket_src_lens=(48,),
+        serve_page_size=4, serve_tiering=True,
+        serve_tier_dir=str(tmp_path_factory.mktemp("mesh_fleet_tiers")))
+    model, params = _model_and_params(cfg)
+    fleet = Fleet(model, params, cfg, replicas=2, sample_seed=0,
+                  mesh_shapes=[(1, 2), ()])
+    assert fleet.replicas[0].engine.mesh is not None
+    assert fleet.replicas[1].engine.mesh is None
+
+    plan = FaultPlan(name="mesh_retire", events=(
+        FaultEvent(kind="spill_storm", at=2, count=3, replica=0),
+        FaultEvent(kind="retire_replica", at=5, replica=1),
+    ))
+    trace = make_trace(zoo_spec("duplicate_storm", 12, seed=5),
+                       cfg, SRC_V, TRIP_V)
+    mon = InvariantMonitor(cfg)
+    report = run_chaos(fleet, trace, plan=plan, monitor=mon, strict=True)
+    assert report.clean and report.checks > 0
+    assert "UNRESOLVED" not in report.outcomes
+    assert sum(report.outcomes.values()) == len(trace.items)
+    names = {e["name"] for e in report.timeline}
+    # retire_replica compiles to permanent decode faults: the fleet hits
+    # the rebuild cap and retires the solo member
+    assert "fleet.retire" in names
+    assert "fault.injected.spill_storm" in names
+    fleet.close()

@@ -199,9 +199,12 @@ _TIER_COLS = (
 def kv_pages_table(snaps: List[dict]) -> str:
     """KV page occupancy per replica — HBM in-use / usable (plus peak),
     with the host/disk tier residency columns when any replica runs the
-    tiered store.  Rectangle-layout replicas (0 usable pages) are skipped;
-    returns "" when nothing is paged."""
+    tiered store, and mesh columns (chip count + worst-chip page load,
+    ISSUE 17) when any replica spans more than one chip.  Rectangle-layout
+    replicas (0 usable pages) are skipped; returns "" when nothing is
+    paged."""
     tiered = any(s.get(key) is not None for s in snaps for _, key in _TIER_COLS)
+    meshed = any((s.get("serve_mesh_devices") or 1) > 1 for s in snaps)
     rows: List[Tuple] = []
     for k, s in enumerate(snaps):
         usable = s.get("serve_kv_pages") or 0
@@ -210,6 +213,11 @@ def kv_pages_table(snaps: List[dict]) -> str:
         used = s.get("serve_kv_pages_in_use") or 0
         row: List = [f"replica{s.get('_index', k)}", used, usable,
                      f"{used / usable:.1%}", s.get("serve_kv_pages_peak") or 0]
+        if meshed:
+            row += [s.get("serve_mesh_devices") or 1,
+                    s.get("serve_kv_pages_in_use_worst_chip")
+                    if s.get("serve_kv_pages_in_use_worst_chip") is not None
+                    else "-"]
         if tiered:
             row += [s[key] if s.get(key) is not None else "-"
                     for _, key in _TIER_COLS]
@@ -217,6 +225,8 @@ def kv_pages_table(snaps: List[dict]) -> str:
     if not rows:
         return ""
     headers: Tuple = ("replica", "hbm_in_use", "usable", "occ", "peak")
+    if meshed:
+        headers += ("chips", "worst_chip")
     if tiered:
         headers += tuple(c for c, _ in _TIER_COLS)
     return _fmt_table(rows, headers)

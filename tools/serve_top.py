@@ -85,12 +85,14 @@ def header_lines(snap: dict, n_snaps: int) -> List[str]:
             f"resubmissions {_g(snap, 'fleet_resubmissions_total')}  "
             f"spawned {_g(snap, 'fleet_replicas_spawned_total')}")
     else:
+        mesh = _g(snap, "serve_mesh_devices", 1)
         out.append(
             f"  engine: queue {_g(snap, 'serve_queue_depth')}  "
             f"busy {_g(snap, 'serve_slots_occupied')}  "
             f"ok {_g(snap, 'serve_requests_ok_total')}  "
             f"shed {_g(snap, 'serve_requests_shed_total')}  "
-            f"gen_tokens {_g(snap, 'serve_gen_tokens_total')}")
+            f"gen_tokens {_g(snap, 'serve_gen_tokens_total')}"
+            + (f"  mesh_chips {mesh}" if mesh > 1 else ""))
     return out
 
 
