@@ -324,6 +324,10 @@ def _measure_one(spec: str, heartbeat=None) -> dict:
     if mode == "tiering":
         # batch field = rect-slot page budget, steps field = request count
         return _measure_tiering(backend, dtype, batch_size, n_steps, heartbeat)
+    if mode == "quant_serve":
+        # batch field = f32 rect-slot page budget, steps field = requests
+        return _measure_quant_serve(backend, dtype, batch_size, n_steps,
+                                    heartbeat)
     if mode == "autoscale":
         # batch field = slots per replica, steps field = request count
         return _measure_autoscale(backend, dtype, batch_size, n_steps,
@@ -1713,6 +1717,210 @@ def _measure_tiering(backend: str, dtype: str, num_slots: int,
     return rec
 
 
+def _measure_quant_serve(backend: str, dtype: str, num_slots: int,
+                         n_requests: int, heartbeat=None) -> dict:
+    """Quantized KV pages + ragged paged-decode kernel drill (ISSUE 18):
+    f32 vs bf16 vs int8 page storage over ONE Poisson request trace.
+
+    Equal-HBM protocol (the ``:tiering`` construction, applied to page
+    bytes instead of the tier ladder): every run's pool is budgeted at
+    exactly ``num_slots`` rectangle slots' worth of f32 page BYTES.  A
+    page dtype with ratio r (``serve/pages.py:KV_PAGE_RATIO`` — f32 1,
+    bf16 2, int8 4) packs r pages into one f32 page's bytes, so the run
+    gets ``serve_num_pages = 1 + r * num_slots * rect_pages_per_slot``
+    pages and serves ``r * num_slots`` slots over them —
+    ``effective_slots`` is r by geometry, honest only if the drill stays
+    clean (OK retires, zero leaks, zero invariant violations).
+
+    Four runs, same trace: an XLA-gather reference engine at f32 (the
+    parity twin), then kernel-decode engines (``backend="pallas"`` —
+    ``ops/paged_decode.py``, interpret mode off-TPU) at f32/bf16/int8.
+    ``kernel_vs_xla_bit_identical`` is the whole-trace token+status
+    comparison of the two f32 runs — the ISSUE 18 acceptance that the
+    blocked kernel IS the gather path bit for bit; the quantized runs
+    record per-dtype ``tps_per_chip`` and ``effective_slots``.  Excluded
+    from the padded-credit headline (generated tokens, not fed nodes);
+    rides the perf ledger like every other variant.
+    """
+    import jax
+    import numpy as np
+
+    from csat_tpu.configs import get_config
+    from csat_tpu.data.toy import random_request_sample
+    from csat_tpu.resilience.invariants import InvariantMonitor
+    from csat_tpu.serve.engine import RequestStatus, ServeEngine
+    from csat_tpu.serve.pages import KV_PAGE_RATIO, page_geometry
+    from csat_tpu.serve.prefill import collate_requests
+
+    overrides = dict(backend=backend, compute_dtype=dtype, prefetch=0,
+                     serve_slots=num_slots,
+                     # deterministic decode paths (serve exactness recipe):
+                     # the f32 kernel-vs-xla leg is a bit-identity claim
+                     full_att=True, dropout=0.0, attention_dropout=0.0,
+                     cse_empty_rows="zero", serve_max_rebuilds=0,
+                     # pinned for BOTH backends: the xla twin and the
+                     # pallas kernel runs must share one sampling stream
+                     noise_mode="counter")
+    probe = get_config("python", **overrides)
+    overrides["bucket_src_lens"] = (probe.max_src_len,)
+    cfg = get_config("python", **overrides)
+    rect_geo = page_geometry(cfg)
+    budget = num_slots * rect_geo.rect_pages_per_slot  # f32 page bytes
+    src_v, tgt_v, trip_v = 10_000, 20_000, 1246
+    steps = cfg.max_tgt_len - 1
+    rng = np.random.default_rng(7)
+    lengths = _skewed_lengths(rng, n_requests, cfg.max_src_len)
+    budgets = np.clip(
+        (steps * rng.lognormal(mean=-1.0, sigma=0.5, size=n_requests)).astype(int),
+        2, steps)
+    samples = [
+        random_request_sample(cfg, src_v, trip_v, int(lengths[i]), seed=700 + i)
+        for i in range(n_requests)
+    ]
+
+    from csat_tpu.train.state import create_train_state, default_optimizer, make_model
+
+    model = make_model(cfg, src_v, tgt_v, trip_v)
+    warm = collate_requests(samples[:1], cfg.max_src_len, num_slots, cfg,
+                            tgt_width=steps)
+    params = create_train_state(
+        model, default_optimizer(cfg), warm, seed=cfg.seed).params
+
+    def run_trace(engine):
+        """ONE Poisson arrival schedule for every run (re-seeded per run,
+        scale pinned to the BASE slot count — the quantized runs face the
+        same offered load, they just have more slots to absorb it)."""
+        arr_rng = np.random.default_rng(8)
+        arrivals = np.cumsum(arr_rng.exponential(
+            scale=float(budgets.mean()) / max(num_slots, 1) / 1.4,
+            size=n_requests))
+        t0 = time.perf_counter()
+        step_clock, nxt, ids = 0, 0, []
+        while nxt < n_requests or engine.occupancy or engine.queue_depth:
+            while nxt < n_requests and arrivals[nxt] <= step_clock:
+                ids.append(engine.submit(samples[nxt],
+                                         max_new_tokens=int(budgets[nxt])))
+                nxt += 1
+            live = engine.tick()
+            step_clock += 1
+            if not live and not engine.queue_depth and nxt < n_requests:
+                step_clock = max(step_clock, int(np.ceil(arrivals[nxt])))
+        wall = time.perf_counter() - t0
+        return wall, [engine.poll(i) for i in ids]
+
+    n_chips = jax.device_count()
+    # (page_dtype, engine backend): the xla f32 twin first, then the
+    # kernel-decode ladder — f32 (parity), bf16, int8 (the HBM claim)
+    plans = [("float32", "xla"), ("float32", "pallas"),
+             ("bfloat16", "pallas"), ("int8", "pallas")]
+    mon = InvariantMonitor(cfg)
+    t_compile = 0.0
+    runs, leaks = [], 0
+    ref = None
+    kernel_f32_identical = False
+    for page_dtype, eng_backend in plans:
+        r = KV_PAGE_RATIO[page_dtype]
+        cfg_d = cfg.replace(backend=eng_backend,
+                            serve_kv_page_dtype=page_dtype,
+                            serve_slots=r * num_slots,
+                            serve_num_pages=1 + r * budget)
+        t0c = time.perf_counter()
+        eng = ServeEngine(model, params, cfg_d, sample_seed=1)
+        eng.generate(
+            [random_request_sample(cfg, src_v, trip_v, spec.n, seed=70 + i)
+             for i, spec in enumerate(eng.specs)],
+            max_new_tokens=2)
+        compiles_warm = eng.stats.compiles
+        t_compile += time.perf_counter() - t0c
+        if heartbeat is not None:
+            heartbeat({"phase": "compiled", "page_dtype": page_dtype,
+                       "impl": eng._kv_impl,
+                       "compile_s": round(t_compile, 1),
+                       "programs": int(compiles_warm)})
+        eng.reset_stats()
+        wall, reqs = run_trace(eng)
+        assert eng.stats.compiles == compiles_warm, "steady-state recompile!"
+        summ = eng.stats.summary(wall_s=wall, n_chips=n_chips)
+        outs = [(r_.status, r_.n_tokens, np.asarray(r_.tokens))
+                for r_ in reqs]
+        if ref is None:
+            ref = outs  # the xla twin is first: the f32 kernel compares
+        elif eng_backend == "pallas" and page_dtype == "float32":
+            mon.check_tokens(
+                {i: o[2] for i, o in enumerate(ref)},
+                {i: o[2] for i, o in enumerate(outs)},
+                label="kernel_bit_identity")
+            kernel_f32_identical = all(
+                a[0] == b[0] and a[1] == b[1] and np.array_equal(a[2], b[2])
+                for a, b in zip(ref, outs))
+        leaks += eng.page_leaks() + eng.chain_leaks()
+        runs.append({
+            "page_dtype": page_dtype,
+            "impl": eng._kv_impl,
+            "kv_page_ratio": r,
+            "engine_slots": cfg_d.serve_slots,
+            "kv_pages": int(summ["kv_pages"]),
+            "effective_slots": summ["effective_slots"],
+            "kv_page_occupancy": summ["kv_page_occupancy"],
+            "wall_s": round(wall, 3),
+            "gen_tokens": int(summ["gen_tokens"]),
+            "tps_per_chip": summ["gen_tokens_per_sec_per_chip"],
+            "ok_requests": sum(1 for r_ in reqs
+                               if r_.status == RequestStatus.OK),
+            "programs": int(compiles_warm),
+        })
+        eng.close()
+        if heartbeat is not None:
+            heartbeat({"phase": "served", "page_dtype": page_dtype,
+                       "impl": runs[-1]["impl"],
+                       "effective_slots": runs[-1]["effective_slots"],
+                       "tps_per_chip": runs[-1]["tps_per_chip"]})
+
+    xla_run = runs[0]
+    kernel_runs = runs[1:]
+    head = kernel_runs[-1]  # int8: the widest-quantization claim
+    violations = list(mon.violations)
+    rec = {
+        "ok": True,
+        "backend": backend,
+        "dtype": dtype,
+        "mode": "quant_serve",
+        "noise_mode": cfg.noise_mode,
+        "device": jax.devices()[0].platform,
+        "n_chips": n_chips,
+        "loss": 0.0,
+        "compile_s": round(t_compile, 1),
+        "steps": 0,
+        "step_ms": round(head["wall_s"] / max(head["gen_tokens"], 1) * 1e3, 2),
+        "num_slots": num_slots,
+        "requests": n_requests,
+        "programs": int(sum(r_["programs"] for r_ in runs)),
+        "gen_tokens": head["gen_tokens"],
+        "gen_tokens_per_sec_per_chip": head["tps_per_chip"],
+        # ---- quantized-page acceptance evidence (ISSUE 18) ----
+        "quant_variants": runs,
+        "kernel_vs_xla_bit_identical": bool(kernel_f32_identical),
+        "effective_slots": head["effective_slots"],
+        "effective_slots_by_dtype": {
+            r_["page_dtype"]: r_["effective_slots"] for r_ in kernel_runs},
+        "tps_per_chip_by_dtype": {
+            r_["page_dtype"]: r_["tps_per_chip"] for r_ in kernel_runs},
+        "xla_tps_per_chip": xla_run["tps_per_chip"],
+        "page_leaks_total": int(leaks),
+        "invariant_checks": mon.checks,
+        "chaos_violations": len(violations),
+        # keep the shared-record contract so the variant table renders
+        "nodes_per_sec_per_chip": 0.0,
+        "real_nodes_per_sec_per_chip": 0.0,
+    }
+    if violations:
+        rec["violation_invariants"] = sorted(
+            {v["invariant"] if isinstance(v, dict) else v.invariant
+             for v in violations})
+    _record_variant_metrics(rec, t_compile)
+    return rec
+
+
 def _measure_autoscale(backend: str, dtype: str, num_slots: int,
                        n_requests: int, heartbeat=None) -> dict:
     """Self-healing elastic fleet drill (ISSUE 13): warm-start store +
@@ -2220,6 +2428,10 @@ def main() -> None:
             # tiered KV page store: 3x slots over a 1x page budget with
             # spill storms + a corrupted-restore fault — see _measure_tiering
             "xla:float32:default:8:24:tiering",
+            # quantized KV pages + the ragged paged-decode kernel: equal-HBM
+            # f32/bf16/int8 ladder + the f32 kernel-vs-xla bit-identity twin
+            # — see _measure_quant_serve
+            "xla:float32:default:8:24:quant_serve",
             # mesh-sharded serving: one replica spanning chips, equal-chip
             # solo-vs-mesh protocol — see _measure_mesh_serve (own child)
             "xla:float32:default:8:24:mesh_serve",
@@ -2255,6 +2467,11 @@ def main() -> None:
             # spill_storm / corrupt_tier_restore fault schedule — see
             # _measure_tiering
             "xla:float32:cpu:2:6:tiering",
+            # quantized KV pages (2-rect-slot f32 byte budget, 6 requests):
+            # xla f32 twin then kernel-decode f32/bf16/int8 on one Poisson
+            # trace — f32 bit-identity + the int8 4x-slots-at-equal-HBM
+            # claim — see _measure_quant_serve
+            "xla:float32:cpu:2:6:quant_serve",
             # mesh-sharded serving (2 slots, 6 requests): solo vs (1,2) vs
             # (1,4) head-sharded topologies on the forced 8-virtual-device
             # platform, equal-chip accounting + bit-identity — runs in its
@@ -2439,6 +2656,7 @@ def main() -> None:
                 and r.get("mode", "fixed") not in ("bucketed", "serve",
                                                    "fleet", "chaos",
                                                    "autoscale", "tiering",
+                                                   "quant_serve",
                                                    "mesh_serve")]
         pool = real or results
         best = max(pool, key=lambda r: r["nodes_per_sec_per_chip"])
@@ -2536,7 +2754,15 @@ def main() -> None:
                                      "mesh_variants", "mesh_skipped",
                                      "mesh_tps_per_chip",
                                      "vs_solo_per_chip",
-                                     "sharded_bit_identical")
+                                     "sharded_bit_identical",
+                                     # quantized KV pages + paged-decode
+                                     # kernel (ISSUE 18)
+                                     "quant_variants",
+                                     "kernel_vs_xla_bit_identical",
+                                     "effective_slots_by_dtype",
+                                     "tps_per_chip_by_dtype",
+                                     "xla_tps_per_chip",
+                                     "page_leaks_total")
                    if k in r}
             # self-describing artifact (r4 verdict weak #6): pallas on CPU is
             # pl.pallas_call(interpret=True) — a correctness canary, not a

@@ -75,7 +75,7 @@ def check_legacy_imports(repo: Repo) -> Iterator[Finding]:
 
 
 @rule("backend-literal",
-      "models/ may not branch on backend name literals; "
+      "models/ and serve/ may not branch on backend name literals; "
       "flex_core.select_impl(cfg.backend) is the single dispatch")
 def check_backend_literals(repo: Repo) -> Iterator[Finding]:
     for ctx in repo.files():

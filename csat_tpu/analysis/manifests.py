@@ -76,10 +76,13 @@ LEGACY_KERNELS = frozenset(
     {"sbm_pallas", "sbm_flash_pallas", "sbm_fused_pallas", "cse_pallas"})
 LEGACY_IMPORT_SCOPE: Tuple[str, ...] = ("csat_tpu/", "tools/")
 
-#: ``models/`` may not grow backend branches outside the flex-core entry
-#: point: ``select_impl(cfg.backend)`` is the single dispatch, so a
-#: ``"pallas"`` string constant outside a docstring is a violation.
-BACKEND_LITERAL_SCOPE = "csat_tpu/models/"
+#: ``models/`` and ``serve/`` may not grow backend branches outside the
+#: flex-core entry point: ``select_impl(cfg.backend)`` is the single
+#: dispatch — the serve engine picks its paged-decode impl through it too
+#: (ISSUE 18) — so a ``"pallas"`` string constant outside a docstring is
+#: a violation.
+BACKEND_LITERAL_SCOPE: Tuple[str, ...] = (
+    "csat_tpu/models/", "csat_tpu/serve/")
 BACKEND_LITERALS = frozenset({"pallas"})
 
 #: Mesh axis names live in ``parallel/mesh.py`` ONLY (``DATA_AXIS`` etc.):

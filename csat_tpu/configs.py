@@ -192,6 +192,17 @@ class Config:
     # trade admission backpressure for memory: the bench's
     # equal-memory-2x-slots configuration sets this explicitly.
     serve_num_pages: int = 0
+    # storage dtype of the paged K/V pool (serve/pages.py): "float32"
+    # stores pages at full precision (per-row scales pinned to 1.0 — the
+    # decode path is bit-identical to the pre-quantization engine),
+    # "bfloat16" halves and "int8" quarters the HBM per page, so at equal
+    # memory the pool funds 2x / 4x the pages (and concurrent slots —
+    # summary()'s effective_slots accounts the ratio). K/V rows are
+    # quantized on write (decode scatter, prefill, tier restore) with a
+    # per-(page, head, token-row) fp32 scale and dequantized on read in
+    # BOTH the XLA gather path and the paged-decode kernel, so backends
+    # agree bit-for-bit at every dtype. Requires the paged layout.
+    serve_kv_page_dtype: str = "float32"
     # cross-request prefix cache (serve/prefix.py): max entries mapping a
     # content hash of the encoder input (the validated request sample) to
     # a refcounted cross-KV page chain — an identical resubmission skips
@@ -561,6 +572,14 @@ class Config:
         assert self.serve_kv_layout in ("paged", "rect"), self.serve_kv_layout
         assert self.serve_page_size >= 1, self.serve_page_size
         assert self.serve_num_pages >= 0, self.serve_num_pages
+        assert self.serve_kv_page_dtype in ("float32", "bfloat16", "int8"), (
+            self.serve_kv_page_dtype)
+        if self.serve_kv_page_dtype != "float32":
+            # quantized storage exists only in the paged pool: the rect
+            # layout's per-slot rectangles have no scale arrays
+            assert self.serve_kv_layout == "paged", (
+                "serve_kv_page_dtype != 'float32' requires "
+                "serve_kv_layout='paged'")
         assert self.serve_prefix_cache >= 0, self.serve_prefix_cache
         assert self.serve_prefill_budget >= 0, self.serve_prefill_budget
         assert self.serve_max_queue >= 0, self.serve_max_queue

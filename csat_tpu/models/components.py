@@ -26,6 +26,7 @@ import jax
 import jax.numpy as jnp
 from flax import linen as nn
 
+from csat_tpu.ops.paged_decode import paged_attend
 from csat_tpu.utils import PAD
 
 Dtype = Any
@@ -220,6 +221,19 @@ class MultiHeadAttention(nn.Module):
             from csat_tpu.parallel.mesh import (
                 constrain_heads, constrain_replicated)
         q = split_heads(self.q_proj(q_in), self.num_heads)
+        if kv is not None and "pages_k" in kv:
+            # ragged paged-decode kernel, cross side (ops/paged_decode.py):
+            # the paged serving pool's kernel impl stamps the raw page
+            # arrays + table rows here instead of a gathered rectangle —
+            # q attends through the page table directly, dequantizing
+            # blocks in VMEM.  Serving decode is deterministic (greedy),
+            # so skipping attn_drop is the identity it would have been.
+            out4, _ = paged_attend(
+                q, kv["pages_k"], kv["pages_v"], kv["scale_k"],
+                kv["scale_v"], kv["table"],
+                mask.reshape(mask.shape[0], mask.shape[-1]), kv["width"],
+                impl="kernel")
+            return self.out_proj(merge_heads(out4).astype(self.dtype)), None
         if kv is not None:
             k, v = kv["k"], kv["v"]
         else:
@@ -228,6 +242,21 @@ class MultiHeadAttention(nn.Module):
         if shard:
             q, k, v = constrain_heads(q), constrain_heads(k), constrain_heads(v)
 
+        if cache is not None and "pages_k" in cache:
+            # ragged paged-decode kernel, self side: the current token's
+            # K/V (this step's projections) are one-hot-merged at each
+            # slot's position inside the kernel — the same selection the
+            # rect path does — and handed back as k_step/v_step for the
+            # decode program to scatter into the page chains (the paged
+            # cache output contract below).
+            out4, _ = paged_attend(
+                q, cache["pages_k"], cache["pages_v"], cache["scale_k"],
+                cache["scale_v"], cache["table"],
+                mask.reshape(mask.shape[0], mask.shape[-1]),
+                cache["width"], idx=cache["idx"], k_tok=k, v_tok=v,
+                impl="kernel")
+            out = self.out_proj(merge_heads(out4).astype(self.dtype))
+            return out, {"k_step": k, "v_step": v}
         if cache is not None:
             # cache: {"k": (B,H,T,dh), "v": (B,H,T,dh), "idx": () | (B,)} —
             # write the new entries at position idx, then attend over the

@@ -229,23 +229,34 @@ class CSATrans(nn.Module):
             for i, layer in enumerate(self.decoder.layers)
         }
 
-    def init_page_pool(self, num_pages: int, page_size: int) -> Dict[str, Any]:
+    def init_page_pool(self, num_pages: int, page_size: int,
+                       kv_dtype: Any = None) -> Dict[str, Any]:
         """Zeroed per-layer K/V **page** arrays for the block-paged serving
         pool (``csat_tpu/serve/pages.py``): ``(num_pages, H, page_size, dh)``
-        per layer for K and V.  One page *id* addresses the same slice of
-        every layer's K and V arrays, so a slot's chain is a single int32
-        row regardless of depth.  Page 0 is the engine's reserved null page
-        (never allocated); fresh arrays per leaf because the pool is
-        donated through the serving programs."""
+        per layer for K and V, stored in ``kv_dtype`` (None = the model
+        dtype; ``serve_kv_page_dtype`` maps int8/bf16 here for quantized
+        pages), plus fp32 ``(num_pages, H, page_size, 1)`` per-token-row
+        dequantization scales — initialized to 1.0 so untouched pages
+        (including the reserved null page 0) dequantize to exact zeros.
+        One page *id* addresses the same slice of every layer's K and V
+        arrays, so a slot's chain is a single int32 row regardless of
+        depth.  Fresh arrays per leaf because the pool is donated through
+        the serving programs."""
         cfg = self.cfg
         dh = cfg.hidden_size // cfg.num_heads
+        dtype = self.dtype if kv_dtype is None else kv_dtype
 
         def zeros():
             return jnp.zeros(
-                (num_pages, cfg.num_heads, page_size, dh), dtype=self.dtype)
+                (num_pages, cfg.num_heads, page_size, dh), dtype=dtype)
+
+        def ones_scale():
+            return jnp.ones(
+                (num_pages, cfg.num_heads, page_size, 1), dtype=jnp.float32)
 
         return {
-            f"layer_{i}": {"k": zeros(), "v": zeros()}
+            f"layer_{i}": {"k": zeros(), "v": zeros(),
+                           "k_scale": ones_scale(), "v_scale": ones_scale()}
             for i in range(len(self.decoder.layers))
         }
 

@@ -1,0 +1,320 @@
+"""Ragged paged-decode attention: one token per slot, read through the page table.
+
+The serving decode step (``serve/pages.py:build_paged_decode_step``) has
+been the one attention hot path outside the blocked-kernel programming
+model ``ops/flex_core.py`` established: it gathers every slot's K/V chain
+into a full ``(S, H, width, dh)`` rectangle in plain XLA each tick, even
+though most slots sit far from their length cap.  This module is the
+flex_core-sibling kernel for that path (PAPERS.md: Ragged Paged
+Attention, arXiv 2604.15464): each slot's single-token query attends
+**directly through its page-table row** — the grid walks ``(slot, head,
+page-block)``, the scalar-prefetched table drives the page-array block
+index, and no rectangle is ever materialized.
+
+Structure mirrors flex_core rather than sharing its mod machinery: decode
+is forward-only (no ``custom_vjp``), q is one row (no q-tiling), and the
+"weight field" degenerates to the caller's key mask — so the kernel is a
+standalone blocked loop reusing flex_core's *idioms*:
+
+* **NULL_PAGE skipping**: a table entry equal to :data:`NULL_PAGE` marks
+  an unallocated chain position.  Its dequantize/copy work is skipped
+  under ``@pl.when`` (dead lanes are written as exact zeros) and counted
+  in a realized-skip output (``skipped`` per ``(slot, head)``), the
+  exact analogue of flex_core's ``skipped_blocks`` —
+  :func:`reference_page_skip` is the XLA occupancy oracle the counter is
+  pinned against.
+* **Pinned reduction order** — flex_core's shared-``_finalize`` idiom:
+  the Pallas body is the *ragged page walk* (block fetch driven by the
+  scalar-prefetched table, in-VMEM dequantize, NULL_PAGE skip), and BOTH
+  impls then run the identical batched :func:`_finalize` (token merge →
+  einsum → scale → mask-fill → softmax → einsum, op for op the oracle's
+  ``models/components.py`` math) on its output.  Reductions therefore
+  execute at the same shapes through the same HLO on either side — which
+  is what makes f32 storage **bit-identical** to
+  ``build_paged_decode_step``'s reference impl (pinned by
+  tests/test_paged_kernel.py).  An in-kernel per-row softmax cannot make
+  that promise on XLA:CPU: the batched matvec emitter's accumulation
+  order is shape- and row-position-dependent, so a per-``(slot, head)``
+  reduction loses the last ulp no matter how its dot is associated.
+* **Interpret mode off-TPU**: the CPU suite executes the real kernel
+  body via ``interpret=True``.
+
+Dead-lane parity: the reference gathers the null page's *contents* for
+NULL_PAGE lanes (finite garbage between attach-scrubs — frozen rows'
+dead writes land there by design) where the kernel writes zeros.  Any
+row with at least one admissible lane cannot see the difference: masked
+K lanes are overwritten with -1e9 before softmax, and masked V lanes get
+exactly-zero attention weight (``exp(-1e9 - max)`` underflows to +0.0),
+so ``0 × finite`` contributes +0.0 on both sides.  Fully-masked rows
+(frozen/empty slots) may differ bitwise — the engine already discards
+them (``nxt`` is gated to PAD).
+
+**Quantized pages** live here too (:func:`quantize_kv` /
+:func:`dequantize_kv` — canonical home; ``serve/pages.py`` re-exports
+them, keeping the import DAG acyclic: models → ops, serve → ops).  Page
+arrays may store f32/bf16/int8 with a sibling fp32 per-(page, head,
+token-row) scale array; the kernel dequantizes each page block in VMEM
+(``stored.astype(f32) * scale``), elementwise-identical to the XLA
+path's gather-then-dequantize, so the parity contract survives
+quantization: f32 is bit-exact, bf16/int8 are bounded-error vs the f32
+oracle.
+
+Masking contract (the oracle's, ``models/components.py:masked_softmax``):
+``mask`` is True/nonzero on **disallowed** key lanes; masked scores are
+replaced with -1e9 *before* softmax, so garbage in dead lanes (nulled
+pages, padding beyond ``width``) never reaches the output of a live row.
+"""
+
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+__all__ = [
+    "NULL_PAGE",
+    "quantize_kv",
+    "dequantize_kv",
+    "paged_attend",
+    "reference_page_skip",
+]
+
+#: Reserved page id 0: never allocated, target of unallocated table
+#: entries and frozen rows' dead writes (canonical here — the kernel's
+#: skip semantics depend on it; ``serve/pages.py`` re-exports it).
+NULL_PAGE = 0
+
+NEG_INF = -1e9  # the oracle's masked-score fill (models/components.py)
+
+
+def _interpret() -> bool:
+    return jax.default_backend() != "tpu"
+
+
+# ---------------------------------------------------------------------------
+# quantized page storage
+# ---------------------------------------------------------------------------
+
+
+def quantize_kv(x: jnp.ndarray, dtype):
+    """Quantize K/V token rows ``x (..., dh)`` for page storage.
+
+    → ``(values, scale)`` with ``values`` in ``dtype`` and ``scale`` fp32
+    ``(..., 1)``.  int8 is symmetric per-row absmax: ``scale = absmax /
+    127`` (1.0 on all-zero rows so the null page dequantizes to exact
+    zeros), values rounded and clipped to [-127, 127].  f32/bf16 are a
+    plain cast with scale pinned to 1.0 — at f32 the quantize→dequantize
+    round trip is bit-identical (``x.astype(f32) × 1.0 == x``), which is
+    what keeps the quantization plumbing out of the pre-existing
+    bit-identity contracts."""
+    if np.dtype(dtype) == np.dtype(np.int8):
+        x = x.astype(jnp.float32)
+        absmax = jnp.max(jnp.abs(x), axis=-1, keepdims=True)
+        scale = jnp.where(absmax > 0, absmax / 127.0, 1.0)
+        q = jnp.clip(jnp.round(x / scale), -127.0, 127.0)
+        return q.astype(jnp.int8), scale.astype(jnp.float32)
+    return x.astype(dtype), jnp.ones(x.shape[:-1] + (1,), jnp.float32)
+
+
+def dequantize_kv(values: jnp.ndarray, scale: jnp.ndarray) -> jnp.ndarray:
+    """Inverse of :func:`quantize_kv`: fp32 values ``= stored × scale``.
+    Elementwise, so gather-then-dequantize (the XLA path) and
+    dequantize-per-page-block (the kernel) agree bit-for-bit."""
+    return values.astype(jnp.float32) * scale
+
+
+# ---------------------------------------------------------------------------
+# XLA reference (the parity oracle: serve/pages.py's gather math verbatim)
+# ---------------------------------------------------------------------------
+
+
+def _gather(pages: jnp.ndarray, table: jnp.ndarray, width: int) -> jnp.ndarray:
+    """``serve/pages.py:gather_chain``'s exact math (duplicated, not
+    imported — serve composes ops, never the reverse): position ``j`` of
+    slot ``s`` is page ``table[s, j // page]`` offset ``j % page``."""
+    np_, h, page, dh = pages.shape
+    s, w = table.shape
+    g = pages[table]                                  # (S, W, H, page, dh)
+    g = g.transpose(0, 2, 1, 3, 4).reshape(s, h, w * page, dh)
+    return g[:, :, :width, :]
+
+
+def _finalize(q, k, v, mask, idx, k_tok, v_tok):
+    """The shared batched finalize — the decode attention the rect/paged
+    XLA paths compute, op for op
+    (``models/components.py:MultiHeadAttention``): one-hot-merge the
+    current token, einsum → scale → mask-fill → softmax → einsum.  BOTH
+    impls run this exact function on their gathered ``(S, H, width, dh)``
+    rectangles, which is what pins the reduction order (flex_core's
+    shared-``_finalize`` idiom) and makes f32 parity bitwise rather than
+    approximate.
+
+    The entry ``optimization_barrier`` is part of the pin: it makes the
+    gathered rectangles materialized values on both sides, so the
+    finalize subgraph hangs off identical operand forms and XLA's (CPU)
+    fusion decisions — which otherwise recompute the reference's
+    gather+dequantize inside each dot operand and shift reduction bits by
+    one ulp — cannot diverge between the two programs."""
+    q, k, v, mask = jax.lax.optimization_barrier((q, k, v, mask))
+    width = k.shape[2]
+    if idx is not None:
+        hot = (jnp.arange(width)[None, :] == idx[:, None])   # (S, width)
+        sel = hot[:, None, :, None]
+        k = jnp.where(sel, k_tok, k)
+        v = jnp.where(sel, v_tok, v)
+    dh = q.shape[-1]
+    scores = jnp.einsum("bhqd,bhkd->bhqk",
+                        q.astype(jnp.float32), k.astype(jnp.float32))
+    scores = scores / math.sqrt(dh)
+    scores = jnp.where(mask[:, None, None, :] != 0, NEG_INF, scores)
+    attn = jax.nn.softmax(scores, axis=-1)
+    return jnp.einsum("bhqk,bhkd->bhqd", attn, v.astype(jnp.float32))
+
+
+def _attend_reference(q, pages_k, pages_v, scale_k, scale_v, table, mask,
+                      width, idx, k_tok, v_tok):
+    """The XLA gather path (the parity oracle): gather+dequantize the
+    rectangle in plain XLA, then the shared :func:`_finalize`."""
+    k = dequantize_kv(_gather(pages_k, table, width),
+                      _gather(scale_k, table, width))
+    v = dequantize_kv(_gather(pages_v, table, width),
+                      _gather(scale_v, table, width))
+    out = _finalize(q, k, v, mask, idx, k_tok, v_tok)
+    return out, reference_page_skip(table, q.shape[1])
+
+
+def reference_page_skip(table: jnp.ndarray, num_heads: int) -> jnp.ndarray:
+    """XLA occupancy oracle for the kernel's realized-skip counter:
+    ``(S, H)`` count of NULL_PAGE entries in each slot's table row (every
+    head walks the same chain, so the count broadcasts over heads)."""
+    cnt = jnp.sum((table == NULL_PAGE).astype(jnp.int32), axis=1)
+    return jnp.broadcast_to(cnt[:, None], (table.shape[0], num_heads))
+
+
+# ---------------------------------------------------------------------------
+# the kernel
+# ---------------------------------------------------------------------------
+
+
+def _decode_body(tab_ref, kp_ref, vp_ref, ks_ref, vs_ref,
+                 ko_ref, vo_ref, skip_ref):
+    """Grid ``(slot s, head h, page-block j)``, j innermost: the ragged
+    page walk.  Each step's block fetch is driven by the scalar-prefetched
+    table row (attention *through* the table — the rectangle gather the
+    XLA path materializes in HBM never exists here); live blocks are
+    dequantized in VMEM into the output strip, NULL_PAGE blocks are
+    skipped and written as exact zeros."""
+    si, _, ji = (pl.program_id(i) for i in range(3))
+
+    @pl.when(ji == 0)
+    def _():
+        skip_ref[0, 0, 0, 0] = 0
+
+    live = tab_ref[si, ji] != NULL_PAGE
+    # realized page-skip counter: increments exactly when @pl.when below
+    # skips this block's dequantize (pinned to reference_page_skip)
+    skip_ref[0, 0, 0, 0] += jnp.where(live, 0, 1)
+
+    @pl.when(live)
+    def _():
+        ko_ref[0, 0] = dequantize_kv(kp_ref[0, 0], ks_ref[0, 0])
+        vo_ref[0, 0] = dequantize_kv(vp_ref[0, 0], vs_ref[0, 0])
+
+    @pl.when(jnp.logical_not(live))
+    def _():
+        # dead lanes must be *defined*: their scores are mask-filled
+        # before softmax either way, but 0-weight × uninitialized-VMEM
+        # could still be NaN on the value side
+        zeros = jnp.zeros(ko_ref.shape[2:], jnp.float32)
+        ko_ref[0, 0] = zeros
+        vo_ref[0, 0] = zeros
+
+
+def _attend_kernel(q, pages_k, pages_v, scale_k, scale_v, table, mask,
+                   width, idx, k_tok, v_tok):
+    s, h, _, dh = q.shape
+    page = pages_k.shape[2]
+    nb = table.shape[1]
+    w_pad = nb * page
+
+    # scalar-prefetched table drives the page-array block index: block j
+    # of slot s reads page table[s, j] — attention *through* the table
+    pgblk = lambda shp: pl.BlockSpec(
+        shp, lambda si, hi, ji, tab: (tab[si, ji], hi, 0, 0),
+        memory_space=pltpu.VMEM)
+    strip = lambda shp: pl.BlockSpec(
+        shp, lambda si, hi, ji, tab: (si, hi, ji, 0),
+        memory_space=pltpu.VMEM)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(s, h, nb),
+        in_specs=[
+            pgblk((1, 1, page, dh)),      # pages_k
+            pgblk((1, 1, page, dh)),      # pages_v
+            pgblk((1, 1, page, 1)),       # scale_k
+            pgblk((1, 1, page, 1)),       # scale_v
+        ],
+        out_specs=[
+            strip((1, 1, page, dh)),      # gathered K strip
+            strip((1, 1, page, dh)),      # gathered V strip
+            pl.BlockSpec((1, 1, 1, 1), lambda si, hi, ji, tab: (si, hi, 0, 0),
+                         memory_space=pltpu.SMEM),
+        ],
+    )
+    kg, vg, skipped = pl.pallas_call(
+        _decode_body,
+        grid_spec=grid_spec,
+        out_shape=[
+            jax.ShapeDtypeStruct((s, h, w_pad, dh), jnp.float32),
+            jax.ShapeDtypeStruct((s, h, w_pad, dh), jnp.float32),
+            jax.ShapeDtypeStruct((s, h, 1, 1), jnp.int32),
+        ],
+        interpret=_interpret(),
+    )(table, pages_k, pages_v, scale_k, scale_v)
+    # static slice to the caller's exact width, then the shared batched
+    # finalize: downstream reductions see the oracle's shapes and ops,
+    # which is what makes f32 bit-identical
+    out = _finalize(q, kg[:, :, :width, :], vg[:, :, :width, :],
+                    mask, idx, k_tok, v_tok)
+    return out, skipped[:, :, 0, 0]
+
+
+# ---------------------------------------------------------------------------
+# entry point
+# ---------------------------------------------------------------------------
+
+
+def paged_attend(q, pages_k, pages_v, scale_k, scale_v, table, mask, width,
+                 *, idx=None, k_tok=None, v_tok=None, impl="reference"):
+    """One decode step of attention through a page table.
+
+    ``q`` (S, H, 1, dh) — one query token per slot.  ``pages_k/v``
+    (NP, H, page, dh) storage-dtype page arrays with fp32 ``scale_k/v``
+    (NP, H, page, 1).  ``table`` (S, NB) int32 chain rows (NULL_PAGE
+    beyond each chain).  ``mask`` (S, width) — nonzero/True on disallowed
+    key lanes.  ``width`` — the exact rectangle width the oracle slices
+    to (``geo.steps`` for self, ``geo.mem_len`` for cross).  Self
+    attention passes ``idx`` (S,) + ``k_tok``/``v_tok`` (S, H, 1, dh) to
+    one-hot-merge the current token at each slot's position; cross passes
+    none.
+
+    → ``(out (S, H, 1, dh) fp32, skipped (S, H) int32)`` where
+    ``skipped`` counts NULL_PAGE blocks realized-skipped per (slot, head)
+    (== :func:`reference_page_skip` exactly, both impls).
+
+    ``impl`` follows the ``ops/flex_core.py:select_impl`` vocabulary:
+    ``"reference"`` is the XLA gather path (the parity oracle),
+    ``"kernel"`` the Pallas kernel (interpret mode off-TPU) — bit-identical
+    at f32 storage, bounded-error at bf16/int8."""
+    q = q.astype(jnp.float32)
+    if idx is not None:
+        k_tok = k_tok.astype(jnp.float32)
+        v_tok = v_tok.astype(jnp.float32)
+    fn = _attend_kernel if impl == "kernel" else _attend_reference
+    return fn(q, pages_k, pages_v, scale_k, scale_v, table, mask, width,
+              idx, k_tok, v_tok)

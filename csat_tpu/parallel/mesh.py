@@ -248,7 +248,11 @@ def constrain_replicated(x: jax.Array) -> jax.Array:
 
 def serve_page_sharding(mesh: Mesh) -> NamedSharding:
     """Sharding for one per-layer page array ``(NP, H, page, dh)``:
-    heads split, page axis replicated (gathers stay chip-local)."""
+    heads split, page axis replicated (gathers stay chip-local).  The
+    quantized pool's fp32 scale leaves ``(NP, H, page, 1)`` (ISSUE 18)
+    carry the head axis in the same rank-4 position, so this one spec
+    covers values and scales alike — each chip dequantizes its own head
+    shard with locally-resident scales, no cross-chip reads."""
     return NamedSharding(mesh, P(None, HEAD_AXIS, None, None))
 
 

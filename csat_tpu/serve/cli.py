@@ -102,6 +102,11 @@ def _parser() -> argparse.ArgumentParser:
                    help="page-pool size incl. the null page; 0 = auto-size "
                         "to every slot's worst case (default: config "
                         "serve_num_pages)")
+    p.add_argument("--kv_page_dtype", default="",
+                   help="float32 | bfloat16 | int8 KV page storage "
+                        "(quantized pages pack 2x/4x slots into the same "
+                        "HBM; requires --kv_layout paged; default: config "
+                        "serve_kv_page_dtype)")
     p.add_argument("--prefix_cache", type=int, default=-1,
                    help="cross-request prefix-cache entries; 0 = off "
                         "(default: config serve_prefix_cache)")
@@ -199,6 +204,8 @@ def build_engine(args):
         overrides["serve_page_size"] = args.page_size
     if getattr(args, "num_pages", -1) >= 0:
         overrides["serve_num_pages"] = args.num_pages
+    if getattr(args, "kv_page_dtype", ""):
+        overrides["serve_kv_page_dtype"] = args.kv_page_dtype
     if getattr(args, "prefix_cache", -1) >= 0:
         overrides["serve_prefix_cache"] = args.prefix_cache
     if getattr(args, "metrics_file", ""):

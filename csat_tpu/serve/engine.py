@@ -69,6 +69,7 @@ from csat_tpu.configs import Config
 from csat_tpu.data.vocab import Vocab
 from csat_tpu.models import CSATrans
 from csat_tpu.obs import EventRecorder, Tracer
+from csat_tpu.ops.flex_core import select_impl
 from csat_tpu.parallel.mesh import (
     build_serve_mesh,
     mesh_descriptor,
@@ -80,6 +81,7 @@ from csat_tpu.resilience.retry import ErrorBudget
 from csat_tpu.resilience.watchdog import StepWatchdog
 from csat_tpu.serve.ingest import PoisonRequestError, validate_sample
 from csat_tpu.serve.pages import (
+    KV_PAGE_RATIO,
     NULL_PAGE,
     PageAllocator,
     build_attach,
@@ -285,6 +287,17 @@ class ServeEngine:
                     "shards")
             self._rep_sh = replicated(self.mesh)
         self.stats.mesh_devices = mesh_devs
+        # decode attention read path, from the flex-core dispatch
+        # vocabulary (ops/flex_core.py:select_impl — the engine never
+        # compares backend names): "kernel" attends straight through the
+        # page tables via the ragged paged-decode kernel
+        # (ops/paged_decode.py), "reference" is the XLA gather oracle.
+        # The kernel has no head-sharded variant, so a serve mesh — and
+        # the rectangle layout, which has no pages at all — pin the
+        # reference path.
+        self._kv_impl = (
+            select_impl(cfg.backend)
+            if self.paged and self.mesh is None else "reference")
         if self.paged:
             self.geo = page_geometry(cfg)
             self._allocator = PageAllocator(self.geo.num_pages)
@@ -292,7 +305,8 @@ class ServeEngine:
                 PrefixCache(cfg.serve_prefix_cache)
                 if cfg.serve_prefix_cache > 0 else None)
             self._pool = init_paged_pool(
-                model, {"params": params}, self.num_slots, self.geo)
+                model, {"params": params}, self.num_slots, self.geo,
+                kv_dtype=cfg.serve_kv_page_dtype)
             if self.mesh is not None:
                 # the engine's long-lived device state goes under explicit
                 # NamedShardings up front; every compiled program below
@@ -372,6 +386,13 @@ class ServeEngine:
                           if self.paged else ()),
                 "prefix": int(self._prefix is not None),
                 "key_seed": cfg.seed + int(sample_seed),
+                # quantized pages change the pool pytree (storage dtype +
+                # scale leaves) and the impl changes the traced attention
+                # graph — both shape every paged executable (satellite,
+                # ISSUE 18; the store re-checks kv_dtype at load →
+                # "dtype_mismatch")
+                "kv_dtype": cfg.serve_kv_page_dtype,
+                "kv_impl": self._kv_impl,
             }
 
         # the ONE decode-step program, AOT-compiled up front (pool donated:
@@ -382,7 +403,8 @@ class ServeEngine:
         # host fetch, no host-side gather) — so each tick stays a single
         # multi-chip dispatch
         step_fn = (build_paged_decode_step(
-            model, self.geo, shard_heads=self.mesh is not None)
+            model, self.geo, shard_heads=self.mesh is not None,
+            impl=self._kv_impl)
             if self.paged else build_decode_step(model))
         step = jax.jit(lambda pool: step_fn(self._dparams, pool),
                        donate_argnums=(0,),
@@ -452,11 +474,18 @@ class ServeEngine:
                 root=root, log=log, obs=self.obs)
             layers = sorted(self._pool.pages)
             probe = self._pool.pages[layers[0]]["k"]
-            # one snapshot is (layers, k|v, chain width, H, page, dh),
-            # zero-padded past the chain — fixed shape, one program each
+            # one snapshot is (layers, k|v, chain width, H, page, dh) in
+            # the page storage dtype, zero-padded past the chain, PLUS the
+            # matching fp32 scale snapshot (…, page, 1) — fixed shapes,
+            # one program each; a tier artifact round-trips quantized
+            # values and scales verbatim, so restore is bit-identical at
+            # every serve_kv_page_dtype
             self._tier_shape = (len(layers), 2, self.geo.cp) + tuple(
                 probe.shape[1:])
             self._tier_dtype = np.dtype(probe.dtype)
+            sprobe = self._pool.pages[layers[0]]["k_scale"]
+            self._tier_scale_shape = (len(layers), 2, self.geo.cp) + tuple(
+                sprobe.shape[1:])
             # spill/restore cross the mesh boundary device-side: the ONE
             # gather program emits the snapshot replicated (out_shardings
             # below — an all-gather on the mesh, a no-op solo), so the
@@ -470,12 +499,13 @@ class ServeEngine:
                 ())
             self.stats.record_compile("tier_gather", (self.geo.cp,))
             fn = jax.jit(build_tier_restore(), donate_argnums=(0,),
-                         **self._mesh_jit_kw(2))
+                         **self._mesh_jit_kw(3))
             self._tier_restore_prog = self._aot_compile(
                 "tier_restore", fn,
                 (self._pool,
                  np.full((self.geo.cp,), self.geo.num_pages, np.int32),
-                 np.zeros(self._tier_shape, self._tier_dtype)), (0,))
+                 np.zeros(self._tier_shape, self._tier_dtype),
+                 np.zeros(self._tier_scale_shape, np.float32)), (0,))
             self.stats.record_compile("tier_restore", self._tier_shape)
         self._nan_prog = None  # built lazily, fault drills only
         self._sync_page_stats()
@@ -998,7 +1028,8 @@ class ServeEngine:
         effective-slots ratio."""
         if self.paged:
             self.stats.set_page_info(
-                self._allocator.usable, self.geo.rect_pages_per_slot)
+                self._allocator.usable, self.geo.rect_pages_per_slot,
+                kv_ratio=KV_PAGE_RATIO[self.cfg.serve_kv_page_dtype])
 
     # ---------------- scheduler internals ----------------
 
@@ -1108,12 +1139,23 @@ class ServeEngine:
         for phash, chain in pairs:
             if self._tiers is not None and chain:
                 row = chain_table_row(chain, self.geo.cp)
-                snap = np.asarray(self._tier_gather_prog(self._pool, row))
+                snap, sscale = self._tier_gather_prog(self._pool, row)
+                snap = np.asarray(snap)
+                sscale = np.asarray(sscale)
                 payload = np.ascontiguousarray(snap[:, :, : len(chain)])
-                self._tiers.put(phash, payload.tobytes(), {
+                scales = np.ascontiguousarray(sscale[:, :, : len(chain)])
+                # quantized values and their fp32 scales travel as ONE
+                # digest-covered byte string (values first); the header
+                # records both shapes/dtypes plus the config-level page
+                # dtype so a restore into a differently-quantized pool is
+                # a structured "dtype_mismatch", never a reinterpret
+                self._tiers.put(phash, payload.tobytes() + scales.tobytes(), {
                     "pages": len(chain),
                     "shape": list(payload.shape),
                     "dtype": payload.dtype.str,
+                    "scale_shape": list(scales.shape),
+                    "scale_dtype": scales.dtype.str,
+                    "kv_dtype": self.cfg.serve_kv_page_dtype,
                 })
             self._allocator.free(chain)
         if pairs and self._tiers is not None:
@@ -1154,13 +1196,28 @@ class ServeEngine:
             self._allocator.free(self_chain)
             self._stamp_tier_stats()
             return _RESTORE_MISS
+        if meta.get("kv_dtype", "float32") != self.cfg.serve_kv_page_dtype:
+            # artifact quantized under another serve_kv_page_dtype: its
+            # bytes are digest-intact but mean nothing to this pool — an
+            # int8 snapshot must never deserialize into an f32 pool (or
+            # vice versa), so the miss is structured and the entry dies
+            self._tiers.invalidate(phash, "dtype_mismatch")
+            self._allocator.free(cross_chain)
+            self._allocator.free(self_chain)
+            self._stamp_tier_stats()
+            return _RESTORE_MISS
         want = (self._tier_shape[0], 2, w) + self._tier_shape[3:]
+        want_s = (self._tier_scale_shape[0], 2, w) + self._tier_scale_shape[3:]
         try:
-            snap = np.frombuffer(
-                payload, dtype=np.dtype(meta["dtype"])).reshape(meta["shape"])
+            vdt = np.dtype(meta["dtype"])
+            kb = int(np.prod(meta["shape"])) * vdt.itemsize
+            snap = np.frombuffer(payload[:kb], dtype=vdt).reshape(meta["shape"])
+            scales = np.frombuffer(
+                payload[kb:], dtype=np.dtype(meta["scale_dtype"])
+            ).reshape(meta["scale_shape"])
         except (KeyError, TypeError, ValueError):
-            snap = None
-        if snap is None or snap.shape != want:
+            snap = scales = None
+        if snap is None or snap.shape != want or scales.shape != want_s:
             # digest-intact bytes that do not decode to THIS pool's
             # snapshot shape (geometry skew) — never scatter them
             self._tiers.invalidate(phash, "truncated")
@@ -1168,12 +1225,22 @@ class ServeEngine:
             self._allocator.free(self_chain)
             self._stamp_tier_stats()
             return _RESTORE_MISS
+        if snap.dtype != self._tier_dtype or scales.dtype != np.float32:
+            # belt-and-braces vs a lying header: the kv_dtype field said
+            # this pool's name but the array dtype disagrees
+            self._tiers.invalidate(phash, "dtype_mismatch")
+            self._allocator.free(cross_chain)
+            self._allocator.free(self_chain)
+            self._stamp_tier_stats()
+            return _RESTORE_MISS
         full = np.zeros(self._tier_shape, self._tier_dtype)
         full[:, :, :w] = snap
+        full_s = np.zeros(self._tier_scale_shape, np.float32)
+        full_s[:, :, :w] = scales
         # sentinel-padded row: padding lanes drop instead of writing page 0
         row = np.full((self.geo.cp,), self.geo.num_pages, np.int32)
         row[:w] = cross_chain
-        self._pool = self._tier_restore_prog(self._pool, row, full)
+        self._pool = self._tier_restore_prog(self._pool, row, full, full_s)
         self._tiers.drop(phash)  # moved back into HBM (a re-spill re-snapshots)
         self.stats.note_tier_restore(time.perf_counter() - t0)
         evicted = self._prefix.insert(phash, cross_chain)
@@ -1262,10 +1329,17 @@ class ServeEngine:
             if self._nan_prog is None:
                 def poison(pool, mask):
                     m = mask[:, None, None, None]
+                    # NaN the fp32 dequant scales rather than the stored
+                    # words: int8 pages cannot hold NaN, and the scales are
+                    # multiplied into every gathered lane regardless of the
+                    # storage dtype, so the poison reaches the logits on
+                    # f32/bf16/int8 pools alike.
                     pages = {
                         layer: {
-                            "k": jnp.where(m, jnp.nan, entry["k"]),
-                            "v": jnp.where(m, jnp.nan, entry["v"]),
+                            "k": entry["k"],
+                            "v": entry["v"],
+                            "k_scale": jnp.where(m, jnp.nan, entry["k_scale"]),
+                            "v_scale": jnp.where(m, jnp.nan, entry["v_scale"]),
                         }
                         for layer, entry in pool.pages.items()
                     }
@@ -1722,7 +1796,8 @@ class ServeEngine:
                 self._tiers.clear()
                 self._stamp_tier_stats()
             self._pool = init_paged_pool(
-                self.model, {"params": self.params}, self.num_slots, self.geo)
+                self.model, {"params": self.params}, self.num_slots, self.geo,
+                kv_dtype=self.cfg.serve_kv_page_dtype)
             if self.mesh is not None:
                 # rebuilt state goes straight back under the canonical
                 # shardings — the carried-over mesh programs require it

@@ -35,6 +35,25 @@ maps to page ``j // page`` offset ``j % page``, and the merge/mask math is
 the rect path's math — so the paged engine is bit-identical to the
 rectangle pool (and to fresh ``greedy_decode``) on deterministic configs,
 pinned by ``tests/test_serve.py``.
+
+**Quantized pages** (``serve_kv_page_dtype``): every page array carries a
+sibling per-(page, head, token-row) fp32 scale array ``(NP, H, page, 1)``
+— ALWAYS present, pinned to 1.0 at f32/bf16 so the program structure,
+tier payload format and mesh shardings are dtype-uniform.  K/V rows are
+quantized on write (:func:`quantize_kv` in the decode scatter and the
+prefill/attach paths) and dequantized on read (:func:`dequantize_kv`, in
+both the XLA gather below and the paged-decode kernel,
+``ops/paged_decode.py``) — at f32 the round trip is ``cast → ×1.0``,
+bit-identical by construction; int8 is symmetric per-row absmax/127.
+
+**Decode dispatch** (``impl``): :func:`build_paged_decode_step` builds the
+XLA gather path (``impl="reference"`` — the parity oracle) or stamps the
+raw page arrays + tables into the cache for
+``models/components.py:MultiHeadAttention`` to attend through the page
+table directly via the ragged paged-decode kernel (``impl="kernel"``,
+``ops/paged_decode.py``) — no rectangle is ever materialized.  The impl
+string comes from ``ops/flex_core.py:select_impl``; neither this module
+nor the engine compares against backend names.
 """
 
 from __future__ import annotations
@@ -46,17 +65,22 @@ import numpy as np
 
 from csat_tpu.configs import Config
 from csat_tpu.models import CSATrans
+from csat_tpu.ops.paged_decode import NULL_PAGE, dequantize_kv, quantize_kv
 from csat_tpu.serve.slots import admit_slot_state
 from csat_tpu.utils import EOS, PAD
 
 __all__ = [
     "NULL_PAGE",
+    "KV_PAGE_DTYPES",
+    "KV_PAGE_RATIO",
     "PageGeometry",
     "PageAllocator",
     "PagedPool",
     "page_geometry",
     "chain_table_row",
     "init_paged_pool",
+    "quantize_kv",
+    "dequantize_kv",
     "build_paged_decode_step",
     "build_attach",
     "build_release",
@@ -64,7 +88,22 @@ __all__ = [
     "build_tier_restore",
 ]
 
-NULL_PAGE = 0  # reserved: never allocated, target of unallocated table entries
+# NULL_PAGE, quantize_kv and dequantize_kv are canonical in
+# ops/paged_decode.py (the kernel's skip/dequant semantics depend on
+# them; serve composes ops, never the reverse) and re-exported here —
+# engine/prefill/tests keep importing them from the pool module.
+
+# serve_kv_page_dtype vocabulary → storage dtype of the K/V page arrays
+KV_PAGE_DTYPES = {
+    "float32": jnp.float32,
+    "bfloat16": jnp.bfloat16,
+    "int8": jnp.int8,
+}
+
+# f32-bytes-per-page / quantized-bytes-per-page: at equal HBM the pool
+# funds this many times the pages (and slots) — the effective_slots
+# multiplier in serve/stats.py and the :quant_serve bench protocol
+KV_PAGE_RATIO = {"float32": 1, "bfloat16": 2, "int8": 4}
 
 
 class PageGeometry(NamedTuple):
@@ -177,6 +216,10 @@ class PagedPool(NamedTuple):
     goes ragged."""
 
     pages: Dict[str, Any]     # per-layer {"k","v"}: (num_pages, H, page, dh)
+    #                           in the serve_kv_page_dtype storage dtype, plus
+    #                           {"k_scale","v_scale"}: (num_pages, H, page, 1)
+    #                           fp32 per-token-row dequantization scales
+    #                           (pinned to 1.0 at f32/bf16)
     self_pt: jnp.ndarray      # (S, SP) int32 — self-KV chain (NULL_PAGE beyond)
     cross_pt: jnp.ndarray     # (S, CP) int32 — cross-KV chain (NULL_PAGE beyond)
     src_mask: jnp.ndarray     # (S, N) bool — True = pad key (all-True when free)
@@ -197,12 +240,15 @@ def chain_table_row(chain: Sequence[int], width: int) -> np.ndarray:
 
 
 def init_paged_pool(model: CSATrans, variables: Any, num_slots: int,
-                    geo: PageGeometry) -> PagedPool:
+                    geo: PageGeometry,
+                    kv_dtype: str = "float32") -> PagedPool:
     """A pool of ``num_slots`` empty slots over ``geo.num_pages`` pages.
     Every slot starts frozen (``limit = 0``) with null page tables;
-    admission (prefill/attach) brings slots live."""
+    admission (prefill/attach) brings slots live.  ``kv_dtype`` is the
+    page storage dtype name (``serve_kv_page_dtype``)."""
     pages = model.apply(
-        variables, geo.num_pages, geo.page, method=CSATrans.init_page_pool)
+        variables, geo.num_pages, geo.page, KV_PAGE_DTYPES[kv_dtype],
+        method=CSATrans.init_page_pool)
     return PagedPool(
         pages=pages,
         self_pt=jnp.full((num_slots, geo.sp), NULL_PAGE, jnp.int32),
@@ -231,8 +277,20 @@ def gather_chain(pages: jnp.ndarray, table: jnp.ndarray, width: int) -> jnp.ndar
     return g[:, :, :width, :]
 
 
+def gather_dequant(entry: Dict[str, Any], key: str, table: jnp.ndarray,
+                   width: int) -> jnp.ndarray:
+    """Gather one K or V rectangle AND its scales through the page table,
+    dequantized to fp32 — the XLA read path quantized storage rides on.
+    At f32 storage the scale gather multiplies by exact 1.0, so this is
+    bit-identical to a plain :func:`gather_chain`."""
+    vals = gather_chain(entry[key], table, width)
+    scale = gather_chain(entry[f"{key}_scale"], table, width)
+    return dequantize_kv(vals, scale)
+
+
 def build_paged_decode_step(model: CSATrans, geo: PageGeometry,
-                            shard_heads: bool = False):
+                            shard_heads: bool = False,
+                            impl: str = "reference"):
     """→ ``step(params, pool) -> (pool, status)``: advance every live slot
     one token, reading K/V through each row's page chain.  Pure and
     shape-stable — the engine AOT-compiles it exactly once (donating the
@@ -253,8 +311,26 @@ def build_paged_decode_step(model: CSATrans, geo: PageGeometry,
     chip-local and op-order-identical to solo, so tokens stay
     bit-identical.  The page gather indexes the UNsharded page axis 0,
     so gathers/scatters never cross chips either.  False (default) emits
-    byte-identical programs to the pre-mesh builder."""
+    byte-identical programs to the pre-mesh builder.
+
+    ``impl`` selects the attention read path (the string comes from
+    ``ops/flex_core.py:select_impl`` — this module never compares backend
+    names): ``"reference"`` gathers each row's K/V rectangle in plain XLA
+    and dequantizes it host-of-kernel (the parity oracle); ``"kernel"``
+    stamps the raw page arrays, scales and table rows into the cache so
+    :class:`~csat_tpu.models.components.MultiHeadAttention` attends
+    directly through the page table via the ragged paged-decode kernel
+    (``ops/paged_decode.py``) — page-granular blocks, NULL_PAGE lanes
+    skipped on-chip, no ``(S, W, H, page, dh)`` gather ever materialized.
+    The kernel pins its reduction order to the oracle's, so the two impls
+    are bit-identical at f32 (tests/test_paged_kernel.py).  The kernel
+    impl composes with quantized pages (dequant inside the kernel) but
+    not with ``shard_heads`` — the engine keeps the mesh path on the
+    reference impl."""
     page = geo.page
+    assert not (shard_heads and impl == "kernel"), (
+        "the paged-decode kernel has no head-sharded variant yet — the "
+        "engine selects the reference impl under a serve mesh")
 
     def step(params, pool: PagedPool):
         if shard_heads:
@@ -266,16 +342,41 @@ def build_paged_decode_step(model: CSATrans, geo: PageGeometry,
         s = pool.pos.shape[0]
         cache = {}
         for layer, entry in pool.pages.items():
+            if impl == "kernel":
+                # hand MultiHeadAttention the pages themselves: the
+                # paged-decode kernel reads per-slot chains page-block by
+                # page-block, so no rectangle is gathered at all
+                cache[layer] = {
+                    "self": {
+                        "pages_k": entry["k"], "pages_v": entry["v"],
+                        "scale_k": entry["k_scale"],
+                        "scale_v": entry["v_scale"],
+                        "table": pool.self_pt, "width": geo.steps,
+                        "idx": pool.pos,
+                        "paged": True,  # components.py: emit k_step/v_step
+                    },
+                    "cross": {
+                        "pages_k": entry["k"], "pages_v": entry["v"],
+                        "scale_k": entry["k_scale"],
+                        "scale_v": entry["v_scale"],
+                        "table": pool.cross_pt, "width": geo.mem_len,
+                    },
+                }
+                continue
             cache[layer] = {
                 "self": {
-                    "k": ch(gather_chain(entry["k"], pool.self_pt, geo.steps)),
-                    "v": ch(gather_chain(entry["v"], pool.self_pt, geo.steps)),
+                    "k": ch(gather_dequant(entry, "k", pool.self_pt,
+                                           geo.steps)),
+                    "v": ch(gather_dequant(entry, "v", pool.self_pt,
+                                           geo.steps)),
                     "idx": pool.pos,
                     "paged": True,  # components.py: emit k_step/v_step only
                 },
                 "cross": {
-                    "k": ch(gather_chain(entry["k"], pool.cross_pt, geo.mem_len)),
-                    "v": ch(gather_chain(entry["v"], pool.cross_pt, geo.mem_len)),
+                    "k": ch(gather_dequant(entry, "k", pool.cross_pt,
+                                           geo.mem_len)),
+                    "v": ch(gather_dequant(entry, "v", pool.cross_pt,
+                                           geo.mem_len)),
                 },
             }
             if shard_heads:
@@ -302,9 +403,16 @@ def build_paged_decode_step(model: CSATrans, geo: PageGeometry,
         for layer, entry in pool.pages.items():
             knew = new_cache[layer]["self"]["k_step"][:, :, 0, :]  # (S, H, dh)
             vnew = new_cache[layer]["self"]["v_step"][:, :, 0, :]
+            # quantize-on-write: each (S, H) token row gets its own scale,
+            # scattered alongside the values — requantization never touches
+            # a page's other rows, so the write is deterministic per token
+            kq, ks = quantize_kv(knew, entry["k"].dtype)
+            vq, vs = quantize_kv(vnew, entry["v"].dtype)
             pages[layer] = {
-                "k": entry["k"].at[page_ids, :, offs, :].set(knew),
-                "v": entry["v"].at[page_ids, :, offs, :].set(vnew),
+                "k": entry["k"].at[page_ids, :, offs, :].set(kq),
+                "v": entry["v"].at[page_ids, :, offs, :].set(vq),
+                "k_scale": entry["k_scale"].at[page_ids, :, offs, :].set(ks),
+                "v_scale": entry["v_scale"].at[page_ids, :, offs, :].set(vs),
             }
 
         t_cap = pool.toks.shape[1]
@@ -361,8 +469,13 @@ def build_attach():
         scrub = self_rows.reshape(-1)  # NULL_PAGE entries re-zero the null page
         pages = {
             layer: {
-                "k": entry["k"].at[scrub].set(0.0),
-                "v": entry["v"].at[scrub].set(0.0),
+                "k": entry["k"].at[scrub].set(
+                    jnp.zeros((), entry["k"].dtype)),
+                "v": entry["v"].at[scrub].set(
+                    jnp.zeros((), entry["v"].dtype)),
+                # scrubbed rows dequantize to exact zeros: 0 × 1.0
+                "k_scale": entry["k_scale"].at[scrub].set(1.0),
+                "v_scale": entry["v_scale"].at[scrub].set(1.0),
             }
             for layer, entry in pool.pages.items()
         }
@@ -377,8 +490,11 @@ def build_attach():
 
 
 def build_tier_gather():
-    """→ ``gather(pool, row) -> (L, 2, W, H, page, dh)``: snapshot one
-    page chain's K/V contents out of every layer for a host-side spill
+    """→ ``gather(pool, row) -> (pages, scales)`` with ``pages``
+    ``(L, 2, W, H, page, dh)`` in the storage dtype and ``scales``
+    ``(L, 2, W, H, page, 1)`` fp32: snapshot one page chain's K/V
+    contents — values AND dequantization scales, so a quantized spill
+    round-trips byte-exactly — out of every layer for a host-side spill
     (``serve/tiering.py``).  ``row`` is a fixed-width ``(W,)`` int32 chain
     padded with NULL_PAGE — padding lanes gather the (zero) null page and
     are sliced off on the host, so ONE compiled program (width fixed at
@@ -387,34 +503,43 @@ def build_tier_gather():
     same order, so the layer axis round-trips by construction."""
 
     def gather(pool: PagedPool, row):
-        outs = []
+        outs, scales = [], []
         for layer in sorted(pool.pages):
             entry = pool.pages[layer]
             outs.append(jnp.stack((entry["k"][row], entry["v"][row])))
-        return jnp.stack(outs)
+            scales.append(jnp.stack(
+                (entry["k_scale"][row], entry["v_scale"][row])))
+        return jnp.stack(outs), jnp.stack(scales)
 
     return gather
 
 
 def build_tier_restore():
-    """→ ``restore(pool, row, payload) -> pool``: scatter a spilled
-    snapshot back into freshly allocated pages — the inverse of
+    """→ ``restore(pool, row, payload, scales) -> pool``: scatter a
+    spilled snapshot back into freshly allocated pages — the inverse of
     :func:`build_tier_gather`, donated like attach/release.  ``row`` is
     padded with an OUT-OF-RANGE sentinel (``geo.num_pages``) so padding
     lanes are dropped by the scatter (``mode="drop"``) instead of writing
     the null page; ``payload`` is the fixed ``(L, 2, W, H, page, dh)``
-    snapshot, zero-padded past the chain length.  Restored pages are
-    byte-for-byte the gathered ones, which is what makes a restored chain
-    bit-identical to one that never left HBM (the digest check upstream
-    guarantees the bytes; this program guarantees the placement)."""
+    snapshot in the storage dtype and ``scales`` its fp32
+    ``(L, 2, W, H, page, 1)`` sibling, zero-padded past the chain length.
+    Restored pages are byte-for-byte the gathered ones — values AND
+    scales — which is what makes a restored chain bit-identical to one
+    that never left HBM at every ``serve_kv_page_dtype`` (the digest
+    check upstream guarantees the bytes; this program guarantees the
+    placement)."""
 
-    def restore(pool: PagedPool, row, payload):
+    def restore(pool: PagedPool, row, payload, scales):
         pages = {}
         for i, layer in enumerate(sorted(pool.pages)):
             entry = pool.pages[layer]
             pages[layer] = {
                 "k": entry["k"].at[row].set(payload[i, 0], mode="drop"),
                 "v": entry["v"].at[row].set(payload[i, 1], mode="drop"),
+                "k_scale": entry["k_scale"].at[row].set(
+                    scales[i, 0], mode="drop"),
+                "v_scale": entry["v_scale"].at[row].set(
+                    scales[i, 1], mode="drop"),
             }
         return pool._replace(pages=pages)
 

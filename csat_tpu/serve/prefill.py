@@ -180,7 +180,7 @@ def build_paged_prefill(model: CSATrans, spec: PrefillSpec, geo):
     rows, the pad mask, and the reset decode state (BOS, position 0,
     budget) land via the same slot-id drop-scatters as the rectangle path.
     """
-    from csat_tpu.serve.pages import NULL_PAGE, PagedPool
+    from csat_tpu.serve.pages import NULL_PAGE, PagedPool, quantize_kv
 
     n = spec.n
     page = geo.page
@@ -217,13 +217,25 @@ def build_paged_prefill(model: CSATrans, spec: PrefillSpec, geo):
 
         pages = {}
         for layer, entry in pool.pages.items():
+            # quantize-on-write: whole cross pages at once, one fp32 scale
+            # per (page, head, token-row) — zero-padded rows quantize to
+            # exact zeros with scale 1.0, matching the scrub convention
+            kq, ks = quantize_kv(paginate(cross[layer]["k"]),
+                                 entry["k"].dtype)
+            vq, vs = quantize_kv(paginate(cross[layer]["v"]),
+                                 entry["v"].dtype)
+            zk = jnp.zeros((), entry["k"].dtype)
             pages[layer] = {
-                "k": entry["k"].at[scrub].set(0.0)
-                                .at[flat_chain].set(paginate(cross[layer]["k"]),
-                                                    mode="drop"),
-                "v": entry["v"].at[scrub].set(0.0)
-                                .at[flat_chain].set(paginate(cross[layer]["v"]),
-                                                    mode="drop"),
+                "k": entry["k"].at[scrub].set(zk)
+                                .at[flat_chain].set(kq, mode="drop"),
+                "v": entry["v"].at[scrub].set(zk)
+                                .at[flat_chain].set(vq, mode="drop"),
+                "k_scale": entry["k_scale"].at[scrub].set(1.0)
+                                           .at[flat_chain].set(ks,
+                                                               mode="drop"),
+                "v_scale": entry["v_scale"].at[scrub].set(1.0)
+                                           .at[flat_chain].set(vs,
+                                                               mode="drop"),
             }
         return PagedPool(
             pages=pages,

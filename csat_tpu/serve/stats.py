@@ -111,6 +111,10 @@ _METRICS = {
                      "allocatable KV pages (0 = rectangle layout)"),
     "rect_pages_per_slot": ("gauge", "serve_rect_pages_per_slot",
                             "equal-memory yardstick (SP + CP)"),
+    "kv_page_ratio": ("gauge", "serve_kv_page_ratio",
+                      "f32 bytes per page / storage bytes per page (1, 2 "
+                      "or 4) — the equal-HBM multiplier quantized KV "
+                      "pages fund"),
     "page_peak": ("gauge", "serve_kv_pages_peak",
                   "high-water KV pages in use"),
     "pages_in_use": ("gauge", "serve_kv_pages_in_use",
@@ -173,6 +177,7 @@ class ServeStats:
     prefix_misses = _Backed()   # cache-enabled admissions that encoded
     pages_usable = _Backed()    # allocatable pages (0 = rectangle layout)
     rect_pages_per_slot = _Backed()  # equal-memory yardstick (SP + CP)
+    kv_page_ratio = _Backed()   # quantized-page HBM multiplier (1 at f32)
     page_peak = _Backed()       # high-water pages in use
     pages_in_use = _Backed()    # last per-tick occupancy sample
     # mesh-sharded serving (ISSUE 17): device span of this engine's serve
@@ -253,11 +258,18 @@ class ServeStats:
             old.compile_events, maxlen=COMPILE_EVENT_WINDOW)
         self.compiles = old.compiles
 
-    def set_page_info(self, usable: int, rect_pages_per_slot: int) -> None:
+    def set_page_info(self, usable: int, rect_pages_per_slot: int,
+                      kv_ratio: int = 1) -> None:
         """Paged-pool geometry (engine init / reset): enables the page
-        occupancy and effective-slots lines in :meth:`summary`."""
+        occupancy and effective-slots lines in :meth:`summary`.
+        ``kv_ratio`` is the quantized-page HBM multiplier
+        (``serve/pages.py:KV_PAGE_RATIO`` — 1 at f32, 2 at bf16, 4 at
+        int8): a usable page of int8 storage holds a quarter the bytes a
+        rectangle-pool f32 page would, so the equal-memory
+        effective-slots ratio scales by it."""
         self.pages_usable = int(usable)
         self.rect_pages_per_slot = int(rect_pages_per_slot)
+        self.kv_page_ratio = int(kv_ratio)
 
     def note_pages(self, used: int, worst_chip: Optional[int] = None) -> None:
         """One per-tick occupancy sample (pages currently allocated).
@@ -343,7 +355,8 @@ class ServeStats:
         peak = self.page_peak / usable if usable else 0.0
         planned = self.prefix_hits + self.prefix_misses
         hit_rate = self.prefix_hits / planned if planned else 0.0
-        eff = (self.num_slots * self.rect_pages_per_slot / usable
+        eff = (self.num_slots * self.rect_pages_per_slot
+               * max(int(self.kv_page_ratio), 1) / usable
                if usable else 1.0)
         return {
             "num_slots": self.num_slots,

@@ -23,8 +23,11 @@ The store itself is HOST-ONLY byte storage with a digest-verified ladder:
 Every restore is digest-verified in BOTH tiers (blake2b-16, the same
 hash family as ``prefix.sample_hash``), so a corrupted snapshot can
 never scatter garbage into a live pool.  Every failure mode —
-``absent | corrupt_header | digest_mismatch | io_error | truncated`` —
-comes back as ``(None, None, reason)`` plus a structured
+``absent | corrupt_header | digest_mismatch | io_error | truncated |
+dtype_mismatch`` (the last stamped by the engine via :meth:`invalidate`
+when an artifact's ``kv_dtype`` header disagrees with the pool's
+``serve_kv_page_dtype`` — an int8 snapshot must never deserialize into
+an f32 pool) — comes back as ``(None, None, reason)`` plus a structured
 ``tier.restore_miss{reason}`` event, and the failed entry is dropped so
 the admission degrades to a clean re-prefill.  :meth:`get`, :meth:`put`
 and :meth:`clear` never raise: the tiers are an optimization, not a
@@ -54,7 +57,7 @@ _MAGIC = "csat-kvtier-v1"
 #: The structured ``tier.restore_miss{reason}`` vocabulary — every way a
 #: restore can fail, none of them an exception.
 MISS_REASONS = ("absent", "corrupt_header", "digest_mismatch", "io_error",
-                "truncated")
+                "truncated", "dtype_mismatch")
 
 
 def _digest(payload: bytes) -> str:

@@ -178,8 +178,9 @@ class WarmStartStore:
              fields: Dict[str, Any]) -> Tuple[Optional[bytes], str]:
         """→ ``(payload, "hit")`` or ``(None, miss reason)``.  The miss
         reason is one of ``disabled | absent | corrupt_header |
-        digest_mismatch | jaxlib_mismatch | mesh_mismatch | io_error`` —
-        the structured ``warmstart_miss{reason}`` vocabulary."""
+        digest_mismatch | jaxlib_mismatch | mesh_mismatch |
+        dtype_mismatch | io_error`` — the structured
+        ``warmstart_miss{reason}`` vocabulary."""
         import jaxlib
 
         if self.root is None:
@@ -209,6 +210,13 @@ class WarmStartStore:
             # same belt and braces for the device topology: an artifact
             # exported under one mesh must never warm-start another
             return None, "mesh_mismatch"
+        if "kv_dtype" in fields and (
+                header.get("fields", {}).get("kv_dtype")
+                != str(fields["kv_dtype"])):
+            # and for the KV page storage dtype (ISSUE 18): a program
+            # compiled over int8 pages must never warm-start an f32 pool
+            # — the pool pytrees don't even match
+            return None, "dtype_mismatch"
         if hashlib.sha256(payload).hexdigest() != want:
             return None, "digest_mismatch"
         return payload, "hit"

@@ -117,6 +117,12 @@ CASES = {
                         return 1
                     return 0
                 """,
+            # serve/ is in scope too (ISSUE 18): the engine picks its
+            # paged-decode impl through select_impl, never by name
+            "csat_tpu/serve/pick.py": """
+                def impl(cfg):
+                    return "kernel" if cfg.backend == "pallas" else "ref"
+                """,
         },
         negative={
             "csat_tpu/models/pick.py": '''
@@ -124,6 +130,12 @@ CASES = {
 
                 def pick(cfg, select_impl):
                     "pallas"
+                    return select_impl(cfg.backend)
+                ''',
+            "csat_tpu/serve/pick.py": '''
+                """serve/ dispatches "pallas" through select_impl too."""
+
+                def impl(cfg, select_impl):
                     return select_impl(cfg.backend)
                 ''',
         },
@@ -364,6 +376,27 @@ def test_suppressed_with_reason(tmp_path, rule):
         f"{rule}: reasoned suppression not honored\n" + report.format())
     assert [f for f in report.suppressed if f.rule == rule], (
         f"{rule}: suppressed finding missing from the ledger")
+
+
+def test_backend_literal_scope_covers_serve_not_ops(tmp_path):
+    """ISSUE 18 scope pin: a planted backend branch in serve/ is caught
+    (the engine must route through select_impl), while ops/ — where the
+    kernels and select_impl itself live — stays out of scope."""
+    root = make_repo(tmp_path, {
+        "csat_tpu/serve/engine.py": """
+            def impl(cfg):
+                return "kernel" if cfg.backend == "pallas" else "reference"
+            """,
+        "csat_tpu/ops/flex_core.py": """
+            def select_impl(backend):
+                return "kernel" if backend == "pallas" else "reference"
+            """,
+    })
+    report = run_lint(root, rules=["backend-literal"])
+    assert [f for f in report.findings
+            if f.path == "csat_tpu/serve/engine.py"], report.format()
+    assert not [f for f in report.findings
+                if f.path == "csat_tpu/ops/flex_core.py"], report.format()
 
 
 # ---------------------------------------------------------------------------

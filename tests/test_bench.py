@@ -67,13 +67,13 @@ def test_alive_tpu_best_variant_wins(bench, monkeypatch, capsys):
     monkeypatch.setattr(bench, "_run_child", fake_child)
     out = _run_main(bench, capsys)
     assert out["device"] == "tpu"
-    # the 4th variant wins: the 5th-10th (bucketed 104, serve 105, fleet
-    # 106, chaos 107, autoscale 108, tiering 109) and mesh_serve (its own
-    # child group) are excluded from the headline pool — vs_baseline
-    # stays defined on the padded-credit fixed-shape protocol
+    # the 4th variant wins: the 5th-11th (bucketed 104, serve 105, fleet
+    # 106, chaos 107, autoscale 108, tiering 109, quant_serve 110) and
+    # mesh_serve (its own child group) are excluded from the headline pool
+    # — vs_baseline stays defined on the padded-credit fixed-shape protocol
     assert out["value"] == 103.0
     assert "degraded" not in out
-    assert len(out["all_variants"]) == 11
+    assert len(out["all_variants"]) == 12
     # one probe + ONE serve for the whole device group (single claim) +
     # one serve for the mesh_serve spec (private 8-virtual-device child)
     assert [c[0] for c in calls] == ["--probe", "--serve", "--serve"]
@@ -340,6 +340,54 @@ def test_tiering_record_fields_survive_embedding(bench, monkeypatch, capsys):
     assert "degraded" not in out  # zero violations: artifact stays clean
 
 
+def test_quant_serve_record_fields_survive_embedding(bench, monkeypatch,
+                                                     capsys):
+    """A quant_serve-mode child record's quantized-page fields (per-dtype
+    effective_slots/tps ladder, the f32 kernel-vs-xla bit-identity verdict,
+    leak/violation counters) must survive into the final JSON's
+    all_variants — they carry the ISSUE 18 equal-HBM quantization claim."""
+    quant_fields = {"kernel_vs_xla_bit_identical": True,
+                    "effective_slots": 4.0,
+                    "effective_slots_by_dtype": {
+                        "float32": 1.0, "bfloat16": 2.0, "int8": 4.0},
+                    "tps_per_chip_by_dtype": {
+                        "float32": 11.5, "bfloat16": 12.1, "int8": 13.9},
+                    "xla_tps_per_chip": 11.4,
+                    "quant_variants": [
+                        {"page_dtype": "float32", "impl": "reference",
+                         "kv_page_ratio": 1},
+                        {"page_dtype": "float32", "impl": "kernel",
+                         "kv_page_ratio": 1},
+                        {"page_dtype": "bfloat16", "impl": "kernel",
+                         "kv_page_ratio": 2},
+                        {"page_dtype": "int8", "impl": "kernel",
+                         "kv_page_ratio": 4}],
+                    "page_leaks_total": 0, "chaos_violations": 0,
+                    "invariant_checks": 1}
+
+    def fake_child(args, timeout_s, cpu_only=False):
+        if args[0] == "--probe":
+            return {"ok": True, "platform": "tpu", "n_devices": 1}, None
+        for spec in args[1].split(","):
+            _emit(bench, {"phase": "start", "spec": spec})
+            rec = _result(spec, 100.0)
+            if rec["mode"] == "quant_serve":
+                rec.update(quant_fields, num_slots=8)
+            _emit(bench, rec)
+        _emit(bench, {"phase": "done"})
+        return {"ok": True, "phase": "done"}, None
+
+    monkeypatch.setattr(bench, "_run_child", fake_child)
+    out = _run_main(bench, capsys)
+    quant_recs = [v for v in out["all_variants"]
+                  if v["mode"] == "quant_serve"]
+    assert quant_recs, "spec list must carry a quant_serve variant"
+    for v in quant_recs:
+        for k, want in quant_fields.items():
+            assert v[k] == want, (k, v)
+    assert "degraded" not in out  # zero violations: artifact stays clean
+
+
 def test_autoscale_violations_mark_artifact_degraded(bench, monkeypatch,
                                                      capsys):
     """The autoscale drill rides the same chaos_violations gate: a run
@@ -395,7 +443,7 @@ def test_killed_serve_retries_untried_first(bench, monkeypatch, capsys):
     monkeypatch.setattr(bench, "_run_child", fake_child)
     out = _run_main(bench, capsys)
     assert state["round"] == 3
-    assert len(out["all_variants"]) == 11
+    assert len(out["all_variants"]) == 12
     assert out["value"] == 300.0
     assert "killed during" not in out.get("notes", "")  # retried successfully
 
@@ -421,7 +469,7 @@ def test_deterministic_error_not_retried(bench, monkeypatch, capsys):
     out = _run_main(bench, capsys)
     assert state["serves"] == 2  # dev + mesh children; error is final: no retry
     assert "non-finite" in out["notes"]
-    assert len(out["all_variants"]) == 10
+    assert len(out["all_variants"]) == 11
 
 
 def test_malformed_bench_variants_flagged(bench, monkeypatch, capsys):
@@ -463,7 +511,7 @@ def test_done_record_authoritative_over_stdout_marker(bench, monkeypatch, capsys
     out = _run_main(bench, capsys)
     assert state["serves"] == 2  # dev + mesh children; no retry round
     assert "serve:" not in out.get("notes", "")
-    assert len(out["all_variants"]) == 11
+    assert len(out["all_variants"]) == 12
     assert "degraded" not in out
 
 

@@ -6,7 +6,9 @@ kernel code compiles and agrees with the XLA side *under Mosaic* on a
 chip (VERDICT r2 item 2).  It intentionally reuses the interpret-mode test
 bodies — the only new information is the compiler — plus the on-chip
 block-skip drill (the tile-skip ``@pl.when`` must actually fire and count
-under Mosaic, not just in the interpreter).
+under Mosaic, not just in the interpreter) and the ragged paged-decode
+drill (``ops/paged_decode.py``: scalar-prefetched page-table walk,
+NULL_PAGE skip, quantized-page dequantize, all on-chip).
 
 Run on TPU hardware with::
 
@@ -122,6 +124,57 @@ def test_block_skip_fires_under_mosaic():
     np.testing.assert_array_equal(
         np.asarray(extras["skipped_blocks"]),
         np.asarray(reference_block_skip(spec, aux, geometry(q))))
+
+
+def test_ragged_paged_decode_under_mosaic():
+    """The serving decode kernel (``ops/paged_decode.py``) on-chip: the
+    scalar-prefetched page-table walk compiles under Mosaic, NULL_PAGE
+    blocks are @pl.when-skipped and counted (the realized counter must
+    equal the XLA occupancy oracle), and the result stays bit-identical
+    to the XLA gather path — the kernel side is data movement plus an
+    elementwise dequantize and both impls share the batched finalize, so
+    unlike the flex forward there is no looser MXU bound to fall back
+    to."""
+    import jax.numpy as jnp
+
+    from csat_tpu.ops.paged_decode import (
+        NULL_PAGE, paged_attend, quantize_kv, reference_page_skip)
+
+    s, h, dh, page, nb = 4, 2, 128, 8, 4
+    num_pages = 1 + s * nb
+    width = 28  # off the page boundary: exercises the static width slice
+    rng = np.random.RandomState(0)
+    table = np.full((s, nb), NULL_PAGE, np.int32)
+    nxt = 1
+    for si, n in enumerate((2, 4, 1, 3)):  # ragged chains, slot 1 full
+        for j in range(n):
+            table[si, j] = nxt
+            nxt += 1
+    table = jnp.asarray(table)
+    q = jnp.asarray(rng.randn(s, h, 1, dh).astype(np.float32))
+    pos = np.array([12, 27, 5, 20], np.int32)
+    mask = jnp.asarray(np.arange(width)[None, :] > pos[:, None])
+    k_tok = jnp.asarray(rng.randn(s, h, 1, dh).astype(np.float32))
+    v_tok = jnp.asarray(rng.randn(s, h, 1, dh).astype(np.float32))
+
+    for dtype in (jnp.float32, jnp.int8):
+        pk, sk = quantize_kv(
+            jnp.asarray(rng.randn(num_pages, h, page, dh).astype(np.float32)),
+            dtype)
+        pv, sv = quantize_kv(
+            jnp.asarray(rng.randn(num_pages, h, page, dh).astype(np.float32)),
+            dtype)
+        out_k, skip_k = paged_attend(
+            q, pk, pv, sk, sv, table, mask, width,
+            idx=jnp.asarray(pos), k_tok=k_tok, v_tok=v_tok, impl="kernel")
+        out_r, skip_r = paged_attend(
+            q, pk, pv, sk, sv, table, mask, width,
+            idx=jnp.asarray(pos), k_tok=k_tok, v_tok=v_tok, impl="reference")
+        np.testing.assert_array_equal(np.asarray(out_k), np.asarray(out_r))
+        oracle = np.asarray(reference_page_skip(table, h))
+        np.testing.assert_array_equal(np.asarray(skip_k), oracle)
+        np.testing.assert_array_equal(np.asarray(skip_r), oracle)
+        assert oracle.sum() > 0, "drill must exercise on-chip block skips"
 
 
 def test_cse_mod_under_mosaic():

@@ -1,0 +1,274 @@
+"""Ragged paged-decode kernel + quantized KV pages (ISSUE 18 tentpole).
+
+Pins the ``ops/paged_decode.py`` parity contracts:
+
+* **f32 bit-identity** — ``paged_attend(impl="kernel")`` (the Pallas
+  ragged page walk, CPU interpret mode) is bit-identical to
+  ``impl="reference"`` (the XLA gather path, the parity oracle) at f32
+  storage, self (token merge) and cross, eager and jitted — the
+  structural guarantee of the shared-``_finalize`` design;
+* **quantized parity** — at bf16/int8 storage the two impls still agree
+  bitwise with each other (both dequantize the same stored bytes), and
+  stay within the quantization error envelope of the f32 oracle;
+* **skip oracle** — the kernel's realized NULL_PAGE skip counter equals
+  :func:`reference_page_skip` (the XLA occupancy oracle) exactly,
+  including slots whose whole chain is unallocated;
+* **round-trip bounds** — quantize→dequantize is exact at f32, and
+  elementwise-bounded at bf16 (half-ulp of an 8-bit mantissa) and int8
+  (half a quantization step of the per-row absmax scale);
+* **engine end-to-end** — a paged engine on ``backend="pallas"``
+  (kernel decode) emits token-for-token the default engine's outputs at
+  f32, and an int8-paged tiered engine still passes the
+  ``restore_bit_identity`` and ``no_chain_leak`` invariants through a
+  forced spill→restore cycle.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from csat_tpu.data.toy import random_request_sample
+from csat_tpu.ops.paged_decode import (
+    NULL_PAGE,
+    dequantize_kv,
+    paged_attend,
+    quantize_kv,
+    reference_page_skip,
+)
+from csat_tpu.resilience import InvariantMonitor
+from csat_tpu.serve import RequestStatus, ServeEngine, collate_requests
+
+SRC_V, TGT_V, TRIP_V = 200, 300, 50
+
+# micro attend problem: 4 ragged chains over 5-page tables, width off the
+# page boundary so the static width slice is exercised
+S, H, DH, PAGE, NB = 4, 3, 16, 4, 5
+NUM_PAGES = 1 + S * NB
+WIDTH = 18
+CHAIN_PAGES = (2, 5, 1, 3)  # slot 1 full, slot 2 nearly empty
+POS = np.array([6, 17, 2, 9], np.int32)  # current position per slot
+
+
+def _problem(dtype, seed=0):
+    """Pages/table/q/mask for a ragged decode step.  The null page holds
+    deliberate garbage (the engine's frozen-row dead writes land there by
+    design) so the tests prove masked lanes can't leak it."""
+    rng = np.random.RandomState(seed)
+    pk = rng.randn(NUM_PAGES, H, PAGE, DH).astype(np.float32)
+    pv = rng.randn(NUM_PAGES, H, PAGE, DH).astype(np.float32)
+    pk[0] *= 3.7
+    pv[0] *= -2.1
+    qk, sk = quantize_kv(jnp.asarray(pk), dtype)
+    qv, sv = quantize_kv(jnp.asarray(pv), dtype)
+    table = np.full((S, NB), NULL_PAGE, np.int32)
+    nxt = 1
+    for s, n in enumerate(CHAIN_PAGES):
+        for j in range(n):
+            table[s, j] = nxt
+            nxt += 1
+    q = jnp.asarray(rng.randn(S, H, 1, DH).astype(np.float32))
+    mask = jnp.asarray(np.arange(WIDTH)[None, :] > POS[:, None])
+    k_tok = jnp.asarray(rng.randn(S, H, 1, DH).astype(np.float32))
+    v_tok = jnp.asarray(rng.randn(S, H, 1, DH).astype(np.float32))
+    return q, qk, qv, sk, sv, jnp.asarray(table), mask, k_tok, v_tok
+
+
+def _run(impl, dtype, self_attn, jit, seed=0):
+    q, qk, qv, sk, sv, table, mask, k_tok, v_tok = _problem(dtype, seed)
+    kw = dict(idx=jnp.asarray(POS), k_tok=k_tok, v_tok=v_tok) if self_attn else {}
+
+    def f():
+        return paged_attend(q, qk, qv, sk, sv, table, mask, WIDTH,
+                            impl=impl, **kw)
+
+    return jax.jit(f)() if jit else f()
+
+
+# ---------------------------------------------------------------------------
+# kernel vs XLA gather: bit-identity and quantized envelopes
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("self_attn", [True, False], ids=["self", "cross"])
+@pytest.mark.parametrize("jit", [False, True], ids=["eager", "jit"])
+def test_f32_kernel_bit_identical_to_gather_oracle(self_attn, jit):
+    """The acceptance contract: at f32 storage the interpret-mode kernel
+    IS the XLA gather path, bit for bit, in both evaluation regimes."""
+    out_k, skip_k = _run("kernel", jnp.float32, self_attn, jit)
+    out_r, skip_r = _run("reference", jnp.float32, self_attn, jit)
+    np.testing.assert_array_equal(np.asarray(out_k), np.asarray(out_r))
+    np.testing.assert_array_equal(np.asarray(skip_k), np.asarray(skip_r))
+
+
+@pytest.mark.parametrize("dtype", [jnp.bfloat16, jnp.int8],
+                         ids=["bf16", "int8"])
+@pytest.mark.parametrize("self_attn", [True, False], ids=["self", "cross"])
+def test_quantized_impls_agree_bitwise(dtype, self_attn):
+    """Quantization doesn't fork the impls: both dequantize the same
+    stored bytes through the same finalize, so kernel == reference
+    bitwise at bf16/int8 too (the error lives in storage, not the path)."""
+    out_k, _ = _run("kernel", dtype, self_attn, jit=True)
+    out_r, _ = _run("reference", dtype, self_attn, jit=True)
+    np.testing.assert_array_equal(np.asarray(out_k), np.asarray(out_r))
+
+
+@pytest.mark.parametrize("dtype,tol", [(jnp.bfloat16, 0.06), (jnp.int8, 0.06)],
+                         ids=["bf16", "int8"])
+@pytest.mark.parametrize("self_attn", [True, False], ids=["self", "cross"])
+def test_quantized_bounded_error_vs_f32_oracle(dtype, tol, self_attn):
+    """bf16/int8 pages stay inside a small absolute envelope of the f32
+    oracle on unit-variance inputs — the error is storage rounding, not a
+    path divergence (softmax keeps outputs O(1))."""
+    out_q, _ = _run("kernel", dtype, self_attn, jit=True)
+    out_f, _ = _run("reference", jnp.float32, self_attn, jit=True)
+    err = float(jnp.max(jnp.abs(out_q - out_f)))
+    assert 0 < err < tol, err
+
+
+def test_skip_counter_equals_occupancy_oracle():
+    """Realized NULL_PAGE skips == the XLA occupancy oracle, per
+    (slot, head), including an all-null chain (an empty slot skips every
+    block)."""
+    q, qk, qv, sk, sv, table, mask, _, _ = _problem(jnp.float32)
+    table = table.at[2].set(NULL_PAGE)  # slot 2: whole chain unallocated
+    _, skipped = paged_attend(q, qk, qv, sk, sv, table, mask, WIDTH,
+                              impl="kernel")
+    oracle = reference_page_skip(table, H)
+    np.testing.assert_array_equal(np.asarray(skipped), np.asarray(oracle))
+    assert int(np.asarray(oracle)[2, 0]) == NB
+    # ragged chains really differ: per-slot counts span the table
+    assert len(set(np.asarray(oracle)[:, 0].tolist())) > 1
+
+
+# ---------------------------------------------------------------------------
+# quantize / dequantize round-trip bounds
+# ---------------------------------------------------------------------------
+
+
+def test_quantize_round_trip_f32_exact():
+    x = jnp.asarray(np.random.RandomState(3).randn(7, 5, 16).astype(np.float32))
+    vals, scale = quantize_kv(x, jnp.float32)
+    assert vals.dtype == jnp.float32
+    np.testing.assert_array_equal(np.asarray(scale), 1.0)
+    np.testing.assert_array_equal(np.asarray(dequantize_kv(vals, scale)),
+                                  np.asarray(x))
+
+
+def test_quantize_round_trip_bf16_half_ulp():
+    x = jnp.asarray(np.random.RandomState(4).randn(7, 5, 16).astype(np.float32))
+    vals, scale = quantize_kv(x, jnp.bfloat16)
+    assert vals.dtype == jnp.bfloat16
+    np.testing.assert_array_equal(np.asarray(scale), 1.0)
+    err = np.abs(np.asarray(dequantize_kv(vals, scale)) - np.asarray(x))
+    # bf16 round-to-nearest: elementwise within half a 7-bit-mantissa ulp
+    assert np.all(err <= 2.0 ** -8 * np.abs(np.asarray(x)) + 1e-30)
+
+
+def test_quantize_round_trip_int8_half_step():
+    rng = np.random.RandomState(5)
+    x = np.where(rng.rand(7, 5, 16) < 0.1, 0.0, rng.randn(7, 5, 16))
+    x = jnp.asarray(x.astype(np.float32))
+    vals, scale = quantize_kv(x, jnp.int8)
+    assert vals.dtype == jnp.int8
+    dq = np.asarray(dequantize_kv(vals, scale))
+    err = np.abs(dq - np.asarray(x))
+    # symmetric absmax/127: elementwise within half a quantization step
+    step = np.broadcast_to(np.asarray(scale), x.shape)
+    assert np.all(err <= 0.5 * step + 1e-7)
+    # all-zero rows pin scale to 1.0 and dequantize to exact zeros (the
+    # scrubbed-page / null-page invariant)
+    zrow, zscale = quantize_kv(jnp.zeros((3, 16)), jnp.int8)
+    np.testing.assert_array_equal(np.asarray(zscale), 1.0)
+    np.testing.assert_array_equal(
+        np.asarray(dequantize_kv(zrow, zscale)), 0.0)
+
+
+# ---------------------------------------------------------------------------
+# engine end-to-end
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def served(micro_config, tmp_path_factory):
+    """Shared model/params + config templates for the engine drills."""
+    from csat_tpu.serve.pages import page_geometry
+    from csat_tpu.train.state import create_train_state, default_optimizer, make_model
+
+    cfg = micro_config.replace(
+        full_att=True, dropout=0.0, attention_dropout=0.0,
+        cse_empty_rows="zero", serve_slots=4, bucket_src_lens=(48,),
+        serve_page_size=4)
+    model = make_model(cfg, SRC_V, TGT_V, TRIP_V)
+    warm = collate_requests(
+        [random_request_sample(cfg, SRC_V, TRIP_V, 8, seed=0)],
+        cfg.max_src_len, 1, cfg, tgt_width=cfg.max_tgt_len - 1)
+    params = create_train_state(
+        model, default_optimizer(cfg), warm, seed=0).params
+    tier_dir = str(tmp_path_factory.mktemp("kv_tiers_int8"))
+    return cfg, model, params, tier_dir
+
+
+def _trace(cfg, n, seed):
+    rng = np.random.default_rng(seed)
+    return [
+        random_request_sample(cfg, SRC_V, TRIP_V, int(ln), seed=900 * seed + i)
+        for i, ln in enumerate(rng.integers(5, cfg.max_src_len, n))
+    ]
+
+
+def test_engine_kernel_decode_bit_identical_to_reference_engine(served):
+    """Whole-engine acceptance: the same trace through
+    ``backend="pallas"`` (kernel paged decode, interpret mode on CPU) and
+    the default backend (XLA gather decode) is token-for-token
+    identical at f32 pages."""
+    cfg, model, params, _ = served
+    samples = _trace(cfg, 6, seed=1)
+    outs = {}
+    for backend in ("xla", "pallas"):
+        eng = ServeEngine(model, params, cfg.replace(backend=backend),
+                          sample_seed=1)
+        assert eng._kv_impl == ("kernel" if backend == "pallas"
+                                else "reference")
+        res = eng.generate(samples, max_new_tokens=5)
+        assert all(r.status == RequestStatus.OK for r in res)
+        outs[backend] = [np.asarray(r.tokens) for r in res]
+        eng.close()
+    for a, b in zip(outs["xla"], outs["pallas"]):
+        np.testing.assert_array_equal(a, b)
+
+
+def test_engine_int8_pages_restore_bit_identity_and_no_chain_leak(served):
+    """int8 pages + kernel decode through a forced spill→restore cycle:
+    tokens match a never-spilled int8 engine (``restore_bit_identity``)
+    and the tier accounting drains clean (``no_chain_leak``)."""
+    cfg, model, params, tier_dir = served
+    base = cfg.replace(backend="pallas", serve_kv_page_dtype="int8",
+                       serve_tiering=True, serve_tier_host_pages=8,
+                       serve_tier_dir=tier_dir)
+    tiered = ServeEngine(model, params, base, sample_seed=1)
+    plain = ServeEngine(model, params, base.replace(serve_tiering=False),
+                        sample_seed=1)
+    try:
+        samples = _trace(cfg, 5, seed=2)
+        ref = {i: np.asarray(r.tokens) for i, r in
+               enumerate(plain.generate(samples, max_new_tokens=4))}
+        first = tiered.generate(samples, max_new_tokens=4)
+        assert all(r.status == RequestStatus.OK for r in first)
+
+        spilled = tiered.spill_all()
+        assert spilled > 0
+        r0 = tiered._tiers.restores
+        got = {i: np.asarray(r.tokens) for i, r in
+               enumerate(tiered.generate(samples, max_new_tokens=4))}
+        assert tiered._tiers.restores > r0, "replay must restore"
+        assert tiered._tiers.restore_misses == 0
+
+        mon = InvariantMonitor(cfg)
+        mon.check_tokens(ref, got, label="restore_bit_identity")
+        assert mon.violations == [], mon.violations
+        assert tiered.page_leaks() == 0
+        assert tiered.chain_leaks() == 0
+    finally:
+        tiered.close()
+        plain.close()

@@ -205,10 +205,14 @@ def test_store_every_miss_reason_is_structured(tmp_path):
     assert store.corrupt_entries() == 1
     miss(b"C" * 16, "digest_mismatch")
 
-    # caller-detected skew routes through the same structured channel
+    # caller-detected skew routes through the same structured channel —
+    # dtype_mismatch IS this path: the engine compares the snapshot
+    # header's kv_dtype meta against its pool and stamps the reason
+    # (ISSUE 18); the store never inspects payload semantics itself
     _put(store, b"S" * 16, b"s" * 32, 1)
-    store.invalidate(b"S" * 16, "truncated")
+    store.invalidate(b"S" * 16, "dtype_mismatch")
     assert not store.has(b"S" * 16)
+    seen["dtype_mismatch"] = True
 
     assert seen.keys() >= set(MISS_REASONS) - {"absent"} and seen["absent"]
     events = rec.named("tier.restore_miss")
