@@ -113,7 +113,10 @@ INJECTOR_CALL_FILES: Tuple[str, ...] = ("csat_tpu/resilience/chaos.py",)
 #: ``jnp.*`` call at all.
 ZERO_SYNC_MODULES: Tuple[str, ...] = (
     "csat_tpu/obs/rtrace.py", "csat_tpu/obs/slo.py",
-    "csat_tpu/serve/router.py")
+    "csat_tpu/serve/router.py",
+    # the streaming client (ISSUE 20) is pure host/stdlib protocol code:
+    # tokens stay plain int lists end to end — not even a numpy copy
+    "csat_tpu/serve/netclient.py")
 
 #: Hot-path roots per module: the per-tick / per-request entry points.
 #: The analyzer expands these through the module's own call graph
@@ -123,6 +126,10 @@ HOT_ROOTS: Dict[str, Tuple[str, ...]] = {
     "csat_tpu/serve/engine.py": (
         "ServeEngine.tick", "ServeEngine.submit", "ServeEngine.poll",
         "ServeEngine.pop_result", "ServeEngine.drain"),
+    # the network front door's per-iteration socket loop (ISSUE 20):
+    # socket I/O lives BETWEEN engine ticks and must never read a device
+    # value onto the host — a sync here would stall every connection
+    "csat_tpu/serve/netfront.py": ("NetFront.step", "NetFront.drain"),
 }
 
 #: Declared cold exits from the hot graph — traversal stops here.  Each
@@ -178,7 +185,10 @@ RNG_MAKERS = frozenset(
 #: event/metric (PR 13's structured-fallback-never-raise contract).
 #: ``csat_tpu/serve/`` covers ``serve/tiering.py`` (ISSUE 16) by
 #: directory: every swallowed restore failure must surface as a
-#: ``tier.restore_miss``/``tier.spill``-style structured event.
+#: ``tier.restore_miss``/``tier.spill``-style structured event — and
+#: ``serve/netfront.py``/``serve/netclient.py`` (ISSUE 20) the same
+#: way: a swallowed protocol failure must surface as a ``net.*`` event
+#: (``net.malformed``, ``net.stall_drop``, ``net.submit_fail``, ...).
 FAULT_SCOPES: Tuple[str, ...] = ("csat_tpu/serve/", "csat_tpu/resilience/")
 
 #: Exception names considered "broad" when caught.
@@ -192,7 +202,10 @@ BROAD_EXCEPTIONS = frozenset({"Exception", "BaseException"})
 EVENT_MARKERS: Tuple[str, ...] = (
     "emit", "record", "observe", "note", "metric", "event", "postmortem",
     "dump", "trip", "fault", "finish", "resubmit", "retire", "fail",
-    "miss", "spill", "log", "warn")
+    "miss", "spill", "log", "warn",
+    # ISSUE 20: net.* protocol outcomes (self._note_malformed,
+    # self._refusal-adjacent helpers named net_*) count as structured
+    "net")
 #: Exact callee names that also qualify (too short for substring match).
 EVENT_MARKER_NAMES = frozenset({"inc"})
 

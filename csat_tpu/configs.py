@@ -348,6 +348,34 @@ class Config:
     # serve_autoscale_churn_window_s window
     serve_autoscale_max_actions: int = 8
     serve_autoscale_churn_window_s: float = 60.0
+    # --- streaming network front door (ISSUE 20: serve/netfront.py) ---
+    # loopback by default: the front door is a protocol layer, not an
+    # exposure decision — binding a routable interface is an explicit act
+    serve_net_host: str = "127.0.0.1"
+    # 0 = ephemeral (the bound port is printed on stderr and readable off
+    # NetFront.port — what the tests and the bench use)
+    serve_net_port: int = 0
+    # per-connection send-buffer bound in bytes: a reader that stops
+    # draining fills this, the connection is marked stalled, and its
+    # streams stop enqueueing — the engine tick never blocks on a socket
+    serve_net_client_buffer: int = 65536
+    # a connection stalled (buffer full, nothing draining) longer than
+    # this is dropped with a structured net.stall_drop; its streams stay
+    # replayable from the frame ring until a resume arrives
+    serve_net_stall_timeout_s: float = 5.0
+    # heartbeat cadence per connection (0 = off): a {"hb": ticks} line so
+    # idle clients can tell a quiet stream from a dead server
+    serve_net_heartbeat_s: float = 0.0
+    # per-request replay ring, in frames: a resume with have_seq older
+    # than the ring's base cannot be replayed exactly-once and is refused
+    serve_net_frame_ring: int = 256
+    # max tokens per streamed frame (0 = everything newly decoded per
+    # tick rides one frame)
+    serve_net_frame_tokens: int = 0
+    # finished streams retained for late resumes (a client that lost its
+    # connection just before the terminal frame must still be able to
+    # fetch it); oldest finished streams are garbage-collected past this
+    serve_net_done_retain: int = 512
     # --- training resilience follow-ups (ROADMAP) ---
     # device-side liveness probe on the step watchdog: a tiny chained
     # collective heartbeat runs on its own thread; if the device stops
@@ -614,6 +642,14 @@ class Config:
             assert self.serve_prefix_cache > 0, (
                 "serve_tiering requires a prefix cache "
                 "(serve_prefix_cache > 0)")
+        assert self.serve_net_port >= 0, self.serve_net_port
+        assert self.serve_net_client_buffer >= 1, self.serve_net_client_buffer
+        assert self.serve_net_stall_timeout_s >= 0, (
+            self.serve_net_stall_timeout_s)
+        assert self.serve_net_heartbeat_s >= 0, self.serve_net_heartbeat_s
+        assert self.serve_net_frame_ring >= 1, self.serve_net_frame_ring
+        assert self.serve_net_frame_tokens >= 0, self.serve_net_frame_tokens
+        assert self.serve_net_done_retain >= 1, self.serve_net_done_retain
         assert len(self.serve_mesh_shape) <= 2, (
             f"serve_mesh_shape {self.serve_mesh_shape}: at most "
             "(data, head) axis sizes")

@@ -52,7 +52,21 @@ Fault kinds (compilation targets in parentheses):
 ``corrupt_tier_restore``  flip payload bytes in every tiered KV snapshot
                       (both tiers, digests kept) so later restores must
                       fail verification and degrade to re-prefill
+``disconnect_mid_stream``  drop the streaming client's connection (ISSUE
+                      20; :func:`run_net_chaos` only — fires on the
+                      client, never the injector)
+``slow_reader``       throttle the client's reads so the server must
+                      stall-account, never block its tick
+``malformed_frame``   inject protocol-violating lines at the server
+``reconnect_storm``   consecutive disconnect/reconnect/resume cycles
 ====================  =====================================================
+
+The :data:`NET_KINDS` family (drawn by ``FaultPlan.random(net=True)``)
+faults the protocol boundary: :func:`run_net_chaos` drives a
+``NetFront``/``NetClient`` pair over real loopback sockets, fires these
+against the client's connection schedule, and closes with the stream
+delivery invariants (``stream_no_token_loss`` / ``stream_no_duplicate``
+/ ``stream_terminal_frame``).
 
 The two fleet-level kinds have no per-tick injector to compile onto — they
 latch state at :meth:`FaultPlan.apply` time (``at`` is ignored) and fire
@@ -68,6 +82,7 @@ from __future__ import annotations
 import dataclasses
 import json
 import os
+import time
 from typing import Any, Dict, List, Optional, Tuple
 
 import numpy as np
@@ -75,12 +90,20 @@ import numpy as np
 from csat_tpu.resilience.faults import FaultInjector
 from csat_tpu.resilience.retry import DataErrorBudgetExceeded
 
-__all__ = ["FaultEvent", "FaultPlan", "ChaosReport", "run_chaos"]
+__all__ = ["FaultEvent", "FaultPlan", "ChaosReport", "run_chaos",
+           "run_net_chaos", "NET_KINDS"]
+
+# network fault family (ISSUE 20): faults on the PROTOCOL boundary, not
+# the device.  They never compile onto the FaultInjector (its ctor
+# surface is pinned by the static scan in tests/test_ops.py) — the net
+# chaos driver fires them against the client/connection schedule instead
+NET_KINDS = ("disconnect_mid_stream", "slow_reader", "malformed_frame",
+             "reconnect_storm")
 
 KINDS = ("nan_logits", "wedge_slot", "hang", "prefill_fail",
          "decode_fault", "reap_storm", "retire_replica",
          "corrupt_warmstart", "kill_during_spawn",
-         "spill_storm", "corrupt_tier_restore")
+         "spill_storm", "corrupt_tier_restore") + NET_KINDS
 
 # kinds that act on the FLEET (warm-start store / spawn hook), not on any
 # engine's injector — latched at apply time, no per-tick schedule
@@ -132,19 +155,23 @@ class FaultPlan:
 
     @staticmethod
     def random(seed: int, n_events: int = 3, replicas: int = 1,
-               slots: int = 4, tiered: bool = False) -> "FaultPlan":
+               slots: int = 4, tiered: bool = False,
+               net: bool = False) -> "FaultPlan":
         """A seeded random storm for the property test.  ``hang`` is
         excluded (it sleeps real wall time) and ``retire_replica`` only
         appears with >1 replica, never aimed at replica 0 — the storm must
         leave at least one replica serving.  ``tiered=True`` (the target
         serves with ``serve_tiering``) adds the two tier kinds to the
-        draw pool."""
+        draw pool; ``net=True`` (the target serves behind a network
+        front door) adds the :data:`NET_KINDS` family."""
         rng = np.random.default_rng(seed)
         kinds = ["nan_logits", "wedge_slot", "prefill_fail", "decode_fault"]
         if replicas > 1:
             kinds += ["reap_storm", "retire_replica"]
         if tiered:
             kinds += ["spill_storm", "corrupt_tier_restore"]
+        if net:
+            kinds += list(NET_KINDS)
         events = []
         for _ in range(n_events):
             kind = kinds[int(rng.integers(0, len(kinds)))]
@@ -200,8 +227,12 @@ class FaultPlan:
 
         out: Dict[int, FaultInjector] = {}
         for k, eng in engines.items():
+            # NET_KINDS never reach the injector: they fault the protocol
+            # boundary, and run_net_chaos compiles them onto the client's
+            # connection schedule instead
             evs = [e for e in self.events
-                   if e.replica == k and e.kind not in FLEET_KINDS]
+                   if e.replica == k and e.kind not in FLEET_KINDS
+                   and e.kind not in NET_KINDS]
             if not evs:
                 continue
             t0 = eng.ticks
@@ -282,6 +313,10 @@ class ChaosReport:
     replicas_spawned: int = 0
     # SLO burn-rate alerts (ISSUE 14): objective name -> times fired
     slo_alerts: Dict[str, int] = dataclasses.field(default_factory=dict)
+    # network front door counters (ISSUE 20, run_net_chaos only): frames,
+    # stall_drops, resumes, reconnects, disconnects, malformed,
+    # dup_frames, gap_frames, forced_reconnects
+    net: Dict[str, int] = dataclasses.field(default_factory=dict)
 
     @property
     def clean(self) -> bool:
@@ -305,6 +340,7 @@ class ChaosReport:
                 "time_to_recover_s": self.time_to_recover_s,
                 "replicas_spawned": self.replicas_spawned,
                 "slo_alerts": self.slo_alerts,
+                "net": self.net,
                 "trace_spec": self.trace_json, "fault_plan": self.plan_json,
             }}) + "\n")
             for rec in self.timeline:
@@ -312,9 +348,11 @@ class ChaosReport:
         return path
 
 
-def _merged_timeline(target: Any, monitor: Any) -> List[dict]:
+def _merged_timeline(target: Any, monitor: Any,
+                     extra: Tuple[Tuple[str, Any], ...] = ()) -> List[dict]:
     """Every component recorder's events as ts-sorted dicts, each stamped
-    with its source component."""
+    with its source component.  ``extra`` adds (component, recorder)
+    pairs — the net driver merges the front door's recorder in."""
     recorders = []
     if hasattr(target, "replicas"):
         recorders.append(("fleet", target.obs))
@@ -324,6 +362,7 @@ def _merged_timeline(target: Any, monitor: Any) -> List[dict]:
         recorders.append(("serve", target.obs))
     if monitor is not None:
         recorders.append(("chaos", monitor.obs))
+    recorders.extend(extra)
     out: List[dict] = []
     for comp, rec in recorders:
         for ts, name, dur, fields in rec.events():
@@ -476,6 +515,205 @@ def run_chaos(
         replicas_spawned=(len(target.replicas) - n_replicas0
                           if is_fleet else 0),
         slo_alerts=dict(slo.fired) if slo is not None else {},
+    )
+    if strict and monitor is not None:
+        monitor.assert_clean(report)
+    return report
+
+
+def run_net_chaos(
+    target: Any,
+    trace: Any,
+    plan: Optional[FaultPlan] = None,
+    monitor: Any = None,
+    strict: bool = True,
+    tick_budget: int = 0,
+    retries: int = 1,
+    force_reconnect: bool = False,
+    slow_reader_bytes: int = 64,
+    slow_window_scale: int = 20,
+) -> ChaosReport:
+    """Drive ``target`` through ``trace`` over REAL loopback sockets: a
+    :class:`~csat_tpu.serve.netfront.NetFront` in front of the target, a
+    :class:`~csat_tpu.serve.netclient.NetClient` submitting the trace's
+    arrivals and assembling the streams, single-threaded co-simulation
+    (``front.step(); client.step()`` per driver iteration — the driver
+    iteration is the schedule clock for arrivals AND net faults).
+
+    ``plan``'s engine kinds compile onto the injector exactly as in
+    :func:`run_chaos`; its :data:`NET_KINDS` fire against the client:
+
+    * ``disconnect_mid_stream`` — drop the connection at iteration
+      ``at``; the client reconnects and resumes.
+    * ``reconnect_storm`` — disconnect on ``3 * count`` consecutive
+      iterations (a thundering reconnect/resume herd).
+    * ``malformed_frame`` — inject ``count`` protocol-violating lines.
+    * ``slow_reader`` — throttle client reads to ``slow_reader_bytes``
+      per step for ``count * slow_window_scale`` iterations (the server
+      must stall-account, never block its tick).
+
+    ``force_reconnect=True`` additionally forces ONE disconnect the
+    moment any stream has partial tokens — the bench's guaranteed
+    mid-stream reconnect.  ``retries`` lets the client honor
+    ``retry_after_s`` refusal hints with resubmits.
+
+    The final check is :meth:`InvariantMonitor.check` over the retained
+    terminal results plus :meth:`InvariantMonitor.check_streams` —
+    streamed assemblies bit-identical to the in-process engine's tokens.
+    """
+    from csat_tpu.serve.netclient import NetClient  # avoid package cycle
+    from csat_tpu.serve.netfront import NetFront
+
+    cfg = target.cfg
+    items = trace.items
+    front = NetFront(
+        target,
+        make_sample=lambda msg: items[int(msg["sample"])].sample)
+    client = NetClient(front.address, clock=front.clock, retries=retries)
+    if plan is not None:
+        plan.apply(target)
+    disconnect_at: set = set()
+    garbage_at: set = set()
+    slow_windows: List[Tuple[int, int]] = []
+    for e in (plan.events if plan is not None else ()):
+        if e.kind == "disconnect_mid_stream":
+            disconnect_at.add(e.at)
+        elif e.kind == "reconnect_storm":
+            disconnect_at.update(range(e.at, e.at + 3 * e.count))
+        elif e.kind == "malformed_frame":
+            garbage_at.update(range(e.at, e.at + e.count))
+        elif e.kind == "slow_reader":
+            slow_windows.append((e.at, e.at + e.count * slow_window_scale))
+
+    steps = cfg.max_tgt_len - 1
+    last_arrival = items[-1].arrival if items else 0
+    budget = tick_budget or (
+        (last_arrival + len(items) + target.num_slots + 1)
+        * (steps + cfg.serve_reap_margin + 4) + 500)
+
+    tags: Dict[int, str] = {}     # trace index -> client tag
+    i = 0
+    it_no = 0
+    forced = 0
+    live = 0
+    try:
+        while True:
+            while i < len(items) and items[i].arrival <= it_no:
+                it = items[i]
+                tags[it.index] = client.submit(
+                    i, priority=it.priority,
+                    max_new_tokens=it.max_new_tokens)
+                i += 1
+            if it_no in disconnect_at:
+                client.disconnect()
+            if it_no in garbage_at:
+                client.send_garbage()
+            client.max_read_bytes = (
+                slow_reader_bytes
+                if any(a <= it_no < b for a, b in slow_windows) else 0)
+            if (force_reconnect and not forced
+                    and any(st.tokens and not st.done
+                            for st in client.streams.values())):
+                client.disconnect()
+                forced = 1
+            live = front.step()
+            client.step()
+            if monitor is not None:
+                monitor.observe_tick(target)
+            it_no += 1
+            if not (i < len(items) or client.pending()
+                    or client.retry_pending() or live
+                    or target.occupancy or target.queue_depth):
+                break
+            wait = client.next_retry_in()
+            if (wait is not None and wait > 0
+                    and not (i < len(items) or client.pending() or live
+                             or target.occupancy or target.queue_depth)):
+                # the run is idle except for a scheduled backoff resubmit:
+                # honor the server's retry_after_s hint by actually waiting
+                # (bounded slices — the clock may be real) instead of
+                # spinning the iteration budget away polling dead sockets
+                time.sleep(min(wait + 1e-3, 0.05))
+            if it_no > budget:
+                raise RuntimeError(
+                    f"net chaos run exceeded {budget} iterations — not "
+                    f"quiescing ({len(items) - i} unsubmitted, "
+                    f"{client.pending()} client-pending, "
+                    f"{client.retry_pending()} retry-pending, {live} live "
+                    f"streams, occupancy {target.occupancy}, queue "
+                    f"{target.queue_depth})")
+    finally:
+        client.close()
+        front.close()
+
+    reqs = front.results()
+    outcomes: Dict[str, int] = {}
+    per_class: Dict[str, Dict[str, Any]] = {}
+    from csat_tpu.serve.stats import percentile
+    lat: Dict[str, List[float]] = {}
+    for it in items:
+        pc = per_class.setdefault(it.pclass, {
+            "priority": it.priority, "submitted": 0, "ok": 0, "browned": 0,
+            "shed": 0, "rejected": 0, "timeout": 0, "failed": 0,
+            "unresolved": 0})
+        pc["submitted"] += 1
+        st = client.streams.get(tags.get(it.index, ""))
+        if st is None or not st.done or st.lost:
+            pc["unresolved"] += 1
+            outcomes["UNRESOLVED"] = outcomes.get("UNRESOLVED", 0) + 1
+            continue
+        outcomes[st.status] = outcomes.get(st.status, 0) + 1
+        key = {"OK": "ok", "SHED": "shed", "REJECTED": "rejected",
+               "TIMEOUT": "timeout", "FAILED": "failed"}.get(st.status)
+        if key:
+            pc[key] += 1
+        if st.browned:
+            pc["browned"] += 1
+        req = reqs.get(st.id) if st.id is not None else None
+        if st.status == "OK" and req is not None:
+            lat.setdefault(it.pclass, []).append(req.done_t - req.submit_t)
+    for name, pc in per_class.items():
+        xs = lat.get(name, [])
+        pc["latency_p50_s"] = round(percentile(xs, 50), 4)
+        pc["latency_p95_s"] = round(percentile(xs, 95), 4)
+
+    violations: List[dict] = []
+    checks = 0
+    if monitor is not None:
+        expected = [st.id for st in client.streams.values()
+                    if st.id is not None and st.id >= 0]
+        monitor.check(target, results=reqs, expected_ids=expected)
+        violations = [dataclasses.asdict(v)
+                      for v in monitor.check_streams(front, client)]
+        checks = monitor.checks
+    is_fleet = hasattr(target, "replicas")
+    report = ChaosReport(
+        trace_name=trace.spec.name,
+        plan_name=plan.name if plan is not None else "none",
+        submitted=len(tags),
+        outcomes=outcomes,
+        per_class=per_class,
+        violations=violations,
+        checks=checks,
+        capacity_frac=round(target.capacity_frac, 4) if is_fleet else 1.0,
+        resubmissions=target.resubmissions if is_fleet else 0,
+        browned=sum(pc["browned"] for pc in per_class.values()),
+        n_ticks=it_no,
+        poison_budget_hits=0,
+        timeline=_merged_timeline(target, monitor,
+                                  extra=(("net", front.obs),)),
+        trace_json=trace.spec.to_json(),
+        plan_json=plan.to_json() if plan is not None else "",
+        net={
+            **front.counters,
+            "reconnects": client.reconnects,
+            "resumes_sent": client.resumes_sent,
+            "dup_frames": client.dup_total(),
+            "gap_frames": client.gap_total(),
+            "forced_reconnects": forced,
+            "client_errors": client.errors,
+            "backoffs": len(client.backoffs),
+        },
     )
     if strict and monitor is not None:
         monitor.assert_clean(report)

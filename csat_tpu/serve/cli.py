@@ -13,6 +13,14 @@ through the L0/L1 extraction pipeline per request
   ``{"id": ..., "code": ...}`` (or a bare string); responses stream out
   as JSON lines as they finish, interleaved with admission — the
   continuous-batching path exercised end to end.  EOF drains and exits.
+* ``serve --net`` — the streaming network front door (ISSUE 20,
+  ``serve/netfront.py``): listen on ``--net_host``/``--net_port`` and
+  stream INCREMENTAL token frames ``{id, seq, tokens, done?, status?}``
+  per request over JSONL/TCP, with per-connection send-buffer
+  backpressure (a slow reader stalls only its own stream — never the
+  engine tick), ``{resume, have_seq}`` replay after reconnects, and
+  refusal frames carrying ``retry_after_s``.  SIGTERM drains: in-flight
+  streams finish or flush a terminal frame before close.
 
 Serving resilience (ISSUE 4): every response carries a ``status``
 (``OK | FAILED | TIMEOUT | REJECTED | SHED`` — serve/engine.py); a
@@ -153,6 +161,27 @@ def _parser() -> argparse.ArgumentParser:
     p.add_argument("--postmortem_dir", default="",
                    help="where fault post-mortem event dumps land (default: "
                         "config obs_postmortem_dir)")
+    p.add_argument("--net", action="store_true",
+                   help="serve: listen on a TCP socket and stream "
+                        "per-token JSONL frames (serve/netfront.py) "
+                        "instead of running the stdin loop")
+    p.add_argument("--net_host", default="",
+                   help="--net listen address (default: config "
+                        "serve_net_host, 127.0.0.1)")
+    p.add_argument("--net_port", type=int, default=-1,
+                   help="--net listen port; 0 = ephemeral, printed to "
+                        "stderr at startup (default: config "
+                        "serve_net_port)")
+    p.add_argument("--net_client_buffer", type=int, default=0,
+                   help="per-connection send-buffer bound in bytes; "
+                        "beyond it the connection is stalled (default: "
+                        "config serve_net_client_buffer)")
+    p.add_argument("--net_stall_timeout_s", type=float, default=-1.0,
+                   help="drop a stalled connection after this long "
+                        "(default: config serve_net_stall_timeout_s)")
+    p.add_argument("--net_heartbeat_s", type=float, default=-1.0,
+                   help="server heartbeat cadence over --net; 0 = off "
+                        "(default: config serve_net_heartbeat_s)")
     p.add_argument("--platform", default="", help="force jax platform (cpu/tpu)")
     p.add_argument("--sep", default="\x00",
                    help="summarize stdin snippet separator (default NUL)")
@@ -230,6 +259,16 @@ def build_engine(args):
         overrides["serve_tier_disk_pages"] = args.tier_disk_pages
     if getattr(args, "tier_dir", ""):
         overrides["serve_tier_dir"] = args.tier_dir
+    if getattr(args, "net_host", ""):
+        overrides["serve_net_host"] = args.net_host
+    if getattr(args, "net_port", -1) >= 0:
+        overrides["serve_net_port"] = args.net_port
+    if getattr(args, "net_client_buffer", 0):
+        overrides["serve_net_client_buffer"] = args.net_client_buffer
+    if getattr(args, "net_stall_timeout_s", -1.0) >= 0:
+        overrides["serve_net_stall_timeout_s"] = args.net_stall_timeout_s
+    if getattr(args, "net_heartbeat_s", -1.0) >= 0:
+        overrides["serve_net_heartbeat_s"] = args.net_heartbeat_s
     cfg = get_config(args.config, **overrides)
 
     src_vocab, tgt_vocab = load_vocab(cfg.data_dir)
@@ -505,7 +544,17 @@ def _serve(args) -> None:
     # response must not deadlock on our next read); when idle, wake at a
     # bounded cadence (PEP 475 restarts select after a signal handler, so
     # an indefinite block would sit through SIGTERM until the next line)
-    with stop.installed():
+    # the teardown stack (not a bare epilogue) is the flight-recorder
+    # guarantee: engine.close() flushes pending postmortem dumps and
+    # finalize() the last metrics snapshot + trace exports EVEN when the
+    # loop dies mid-flight (poison-budget trip, rebuild-cap RuntimeError,
+    # SIGTERM under load) — a crash must never lose the final window
+    import contextlib
+
+    with contextlib.ExitStack() as teardown:
+        teardown.callback(finalize)      # LIFO: close() runs first
+        teardown.callback(engine.close)
+        teardown.enter_context(stop.installed())
         while not eof or pending or engine.occupancy or engine.queue_depth:
             if stop.triggered and drain_deadline is None:
                 # graceful drain: stop intake, finish what is in flight,
@@ -563,9 +612,75 @@ def _serve(args) -> None:
                 if slo is not None and slo.alerts:
                     hb["slo_alerts"] = sorted(slo.alerts)
                 print(f"# heartbeat {json.dumps(hb)}", file=sys.stderr)
-    engine.close()
-    finalize()
     print(json.dumps(_summary(engine, n_chips)), file=sys.stderr)
+
+
+def _serve_net(args) -> None:
+    """``csat_tpu serve --net``: the streaming front door
+    (``serve/netfront.py``) over the same engine/fleet bring-up as the
+    stdin loop.  Submissions arrive as ``{"sample": <code string>, ...}``
+    JSONL over TCP; responses stream back as incremental token frames.
+    SIGTERM/SIGINT stops intake and drains — every in-flight stream
+    finishes or flushes a terminal frame before the socket closes."""
+    import contextlib
+    import time as _time
+
+    from csat_tpu.resilience.preemption import PreemptionHandler
+    from csat_tpu.serve.ingest import sample_from_source
+    from csat_tpu.serve.netfront import NetFront
+
+    engine, cfg, src_vocab, trip_vocab = build_engine(args)
+    writer, extra, finalize = _telemetry(engine, cfg, args)
+    scaler = None
+    if cfg.serve_autoscale and _is_fleet(engine):
+        from csat_tpu.serve.autoscale import AutoScaler
+
+        scaler = AutoScaler(engine, cfg,
+                            log=lambda m: print(m, file=sys.stderr))
+    slo = None
+    if args.slo:
+        from csat_tpu.obs.slo import SLOEngine
+
+        slo = SLOEngine.for_target(engine, cfg)
+        if scaler is not None:
+            scaler.slo = slo
+
+    def make_sample(msg):
+        code = msg.get("sample")
+        if not isinstance(code, str):
+            raise ValueError("'sample' must be the code string")
+        return sample_from_source(code, cfg, src_vocab, trip_vocab)
+
+    front = NetFront(engine, make_sample=make_sample)
+    # the bound address first (port 0 = ephemeral): clients parse this
+    print(json.dumps({"net": {"host": front.address[0],
+                              "port": front.address[1]}}),
+          file=sys.stderr, flush=True)
+    import jax
+
+    n_chips = jax.device_count()
+    stop = PreemptionHandler()
+    with contextlib.ExitStack() as teardown:
+        teardown.callback(finalize)      # LIFO: close/drain run first
+        teardown.callback(engine.close)
+        teardown.callback(front.drain)   # terminal frames before close
+        teardown.enter_context(stop.installed())
+        while not stop.triggered:
+            live = front.step()
+            if scaler is not None:
+                scaler.step()
+            if slo is not None:
+                slo.step()
+            if writer is not None:
+                writer.maybe_write(extra=extra())
+            if not live and not engine.queue_depth:
+                _time.sleep(0.005)  # idle: don't spin the socket loop
+        if stop.triggered:
+            print("# serve: shutdown signal — draining "
+                  f"{front.summary()['live_streams']} stream(s)",
+                  file=sys.stderr, flush=True)
+    print(json.dumps({**_summary(engine, n_chips),
+                      "net": front.summary()}), file=sys.stderr)
 
 
 def main(argv: Optional[List[str]] = None) -> None:
@@ -587,6 +702,8 @@ def main(argv: Optional[List[str]] = None) -> None:
     args = _parser().parse_args(argv)
     if command == "summarize":
         _summarize(args)
+    elif getattr(args, "net", False):
+        _serve_net(args)
     else:
         _serve(args)
 

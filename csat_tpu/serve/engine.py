@@ -918,6 +918,39 @@ class ServeEngine:
         out = [self.tgt_vocab.i2w.get(int(t), "<unk>") for t in toks]
         return out[: out.index(EOS_WORD)] if EOS_WORD in out else out
 
+    def partial_tokens(self) -> Dict[int, np.ndarray]:
+        """Tokens decoded so far for every IN-FLIGHT slot, keyed by request
+        id — the streaming poll surface the network front door
+        (``serve/netfront.py``) frames incremental responses from.
+
+        Reads the host status mirror the last tick already fetched and
+        pulls the token pool ONCE (outside :meth:`tick` — the caller paces
+        this, so a slow consumer costs its own wall time, never the
+        scheduler's).  A slot flagged non-finite excludes its newest token
+        (argmax of garbage — the same token the NaN-guard retire drops),
+        so no frame ever carries a token the final result won't.  Across a
+        rebuild the re-queued request's position restarts at zero; decode
+        is deterministic, so the re-decoded prefix matches what was
+        already framed and the caller just waits for pos to pass its
+        cursor."""
+        out: Dict[int, np.ndarray] = {}
+        if self._status is None:
+            return out
+        pos = self._status[:, 0]
+        bad = self._status[:, 2]
+        toks = None
+        for i, req in enumerate(self._slots):
+            if req is None:
+                continue
+            n = int(pos[i]) - (1 if bad[i] else 0)
+            n = min(n, req.limit)
+            if n <= 0:
+                continue
+            if toks is None:
+                toks = np.asarray(self._pool.toks)
+            out[req.id] = np.array(toks[i, :n])
+        return out
+
     @property
     def occupancy(self) -> int:
         return sum(r is not None for r in self._slots)

@@ -63,7 +63,7 @@ from __future__ import annotations
 import dataclasses
 import os
 import time
-from typing import Any, Callable, Dict, List, Optional, Sequence
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
 from csat_tpu.configs import Config
 from csat_tpu.obs import EventRecorder, Tracer
@@ -342,6 +342,25 @@ class Fleet:
         self._routes.pop(fid, None)
         self._stamp_retry_record(req, self._pending.pop(fid, None))
         return req
+
+    def partial_tokens(self) -> Dict[int, "np.ndarray"]:
+        """In-flight tokens-so-far keyed by FLEET id (the engine-shaped
+        streaming surface ``serve/netfront.py`` polls).  A request serving
+        its resubmission backoff has no live slot and simply doesn't
+        appear; after the retry lands its re-decoded prefix is identical
+        (deterministic decode — the PR 11 bit-identity contract), so a
+        streaming consumer's cursor stays valid across the move."""
+        rev: Dict[Tuple[int, int], int] = {
+            route: fid for fid, route in self._routes.items()}
+        out: Dict[int, "np.ndarray"] = {}
+        for rep in self.replicas:
+            if rep.closed:
+                continue
+            for eid, toks in rep.engine.partial_tokens().items():
+                fid = rev.get((rep.index, eid))
+                if fid is not None:
+                    out[fid] = toks
+        return out
 
     @staticmethod
     def _stamp_retry_record(req: Request,

@@ -149,6 +149,22 @@ _METRICS = {
                       "digest-verified chains restored into HBM"),
     "tier_restore_misses": ("counter", "serve_tier_restore_miss_total",
                             "failed restores degraded to re-prefill"),
+    # streaming network front door (serve/netfront.py, ISSUE 20)
+    "net_connections": ("gauge", "serve_net_connections",
+                        "client connections currently open"),
+    "net_stalled": ("gauge", "serve_net_stalled",
+                    "connections over the send-buffer bound right now"),
+    "net_frames": ("counter", "serve_net_frames_total",
+                   "token/terminal frames queued to clients"),
+    "net_stall_drops": ("counter", "serve_net_stall_drops_total",
+                        "connections dropped after serve_net_stall_timeout_s "
+                        "over the send-buffer bound"),
+    "net_resumes": ("counter", "serve_net_resumes_total",
+                    "streams resumed via {resume, have_seq} replay"),
+    "net_disconnects": ("counter", "serve_net_disconnects_total",
+                        "client connections closed (any reason)"),
+    "net_malformed": ("counter", "serve_net_malformed_total",
+                      "unparseable / protocol-violating client lines"),
 }
 
 
@@ -204,6 +220,15 @@ class ServeStats:
     tier_demotions = _Backed()
     tier_restores = _Backed()
     tier_restore_misses = _Backed()
+    # network front door (serve/netfront.py): connection / stream counters
+    # stamped by the socket loop — never by the engine tick
+    net_connections = _Backed()
+    net_stalled = _Backed()
+    net_frames = _Backed()
+    net_stall_drops = _Backed()
+    net_resumes = _Backed()
+    net_disconnects = _Backed()
+    net_malformed = _Backed()
 
     def __init__(self, num_slots: int,
                  registry: Optional[MetricsRegistry] = None):
@@ -397,4 +422,12 @@ class ServeStats:
             "tier_restores": self.tier_restores,
             "restore_miss_total": self.tier_restore_misses,
             "tier_restore_p95_s": round(percentile(self.tier_restore_s, 95), 4),
+            # network front door (zeros when serving without --net)
+            "net_connections": self.net_connections,
+            "net_stalled": self.net_stalled,
+            "net_frames": self.net_frames,
+            "net_stall_drops": self.net_stall_drops,
+            "net_resumes": self.net_resumes,
+            "net_disconnects": self.net_disconnects,
+            "net_malformed": self.net_malformed,
         }

@@ -208,6 +208,24 @@ CASES = {
                 def span_end(arr):
                     return arr.item()
                 """,
+            # netclient is a ZERO_SYNC module (ISSUE 20): even a host
+            # transfer of the token list is off-contract
+            "csat_tpu/serve/netclient.py": """
+                import numpy as np
+
+                def decode(frame):
+                    return np.asarray(frame["tokens"])
+                """,
+            # netfront's socket loop is a HOT_ROOTS graph: a sync read
+            # in a helper reached from step() stalls every connection
+            "csat_tpu/serve/netfront.py": """
+                class NetFront:
+                    def step(self):
+                        return self._pump()
+
+                    def _pump(self):
+                        return self.last_tokens.item()
+                """,
         },
         negative={
             "csat_tpu/obs/rtrace.py": """
@@ -216,11 +234,31 @@ CASES = {
                     # arg is indexing API, not the zero-arg sync read
                     return sorted(spans.items()), arr.item(0)
                 """,
+            "csat_tpu/serve/netclient.py": """
+                def decode(frame):
+                    # plain host ints end to end: the zero-sync contract
+                    return [int(t) for t in frame["tokens"]]
+                """,
+            "csat_tpu/serve/netfront.py": """
+                class NetFront:
+                    def step(self):
+                        return len(self.conns)
+
+                    def debug_probe(self, arr):
+                        # unreachable from step/drain: off the hot graph
+                        return arr.item()
+                """,
         },
         suppressed={
             "csat_tpu/obs/rtrace.py": """
                 def span_end(arr):
                     return arr.item()  # csat-lint: disable=host-sync trace self-test reads its own fixture
+                """,
+            "csat_tpu/serve/netclient.py": """
+                import numpy as np
+
+                def decode(frame):
+                    return np.asarray(frame["tokens"])  # csat-lint: disable=host-sync golden-frame comparison in the protocol self-test
                 """,
         },
     ),
@@ -299,6 +337,14 @@ CASES = {
                     except Exception:
                         pass
                 """,
+            # a dropped protocol read with no net.* outcome (ISSUE 20)
+            "csat_tpu/serve/netfront.py": """
+                def read_lines(conn):
+                    try:
+                        return conn.sock.recv(65536)
+                    except Exception:
+                        conn.buf = b""
+                """,
         },
         negative={
             "csat_tpu/serve/pool.py": """
@@ -312,6 +358,15 @@ CASES = {
                     except Exception as e:
                         obs.emit("reap_failed", err=str(e))
                 """,
+            "csat_tpu/serve/netfront.py": """
+                def read_lines(self, conn):
+                    try:
+                        return conn.sock.recv(65536)
+                    except Exception:
+                        # the ``net`` marker: the failure became a
+                        # structured net.* protocol outcome
+                        self._net_stall_drop(conn)
+                """,
         },
         suppressed={
             "csat_tpu/serve/pool.py": """
@@ -319,6 +374,13 @@ CASES = {
                     try:
                         worker.join()
                     except Exception:  # csat-lint: disable=swallowed-fault shutdown path, nothing left to tell
+                        pass
+                """,
+            "csat_tpu/serve/netfront.py": """
+                def close_conn(conn):
+                    try:
+                        conn.sock.close()
+                    except Exception:  # csat-lint: disable=swallowed-fault socket already dead on teardown
                         pass
                 """,
         },

@@ -67,13 +67,14 @@ def test_alive_tpu_best_variant_wins(bench, monkeypatch, capsys):
     monkeypatch.setattr(bench, "_run_child", fake_child)
     out = _run_main(bench, capsys)
     assert out["device"] == "tpu"
-    # the 4th variant wins: the 5th-11th (bucketed 104, serve 105, fleet
-    # 106, chaos 107, autoscale 108, tiering 109, quant_serve 110) and
-    # mesh_serve (its own child group) are excluded from the headline pool
-    # — vs_baseline stays defined on the padded-credit fixed-shape protocol
+    # the 4th variant wins: the 5th-12th (bucketed 104, serve 105, fleet
+    # 106, chaos 107, autoscale 108, tiering 109, quant_serve 110,
+    # netfront 111) and mesh_serve (its own child group) are excluded from
+    # the headline pool — vs_baseline stays defined on the padded-credit
+    # fixed-shape protocol
     assert out["value"] == 103.0
     assert "degraded" not in out
-    assert len(out["all_variants"]) == 12
+    assert len(out["all_variants"]) == 13
     # one probe + ONE serve for the whole device group (single claim) +
     # one serve for the mesh_serve spec (private 8-virtual-device child)
     assert [c[0] for c in calls] == ["--probe", "--serve", "--serve"]
@@ -264,6 +265,48 @@ def test_chaos_violations_mark_artifact_degraded(bench, monkeypatch, capsys):
     assert "chaos" in out.get("notes", "")
 
 
+def test_netfront_record_fields_survive_embedding(bench, monkeypatch, capsys):
+    """A netfront-mode child record's drill fields (trace/plan identity,
+    invariant verdict, per-class p95, frame/stall/resume counters, the
+    wedged-reader tick-latency ratio) must survive into the final JSON's
+    all_variants — they carry the ISSUE 20 network-front-door claim."""
+    net_fields = {"trace": "bursty_multitenant",
+                  "fault_plan": ["disconnect_mid_stream", "slow_reader",
+                                 "reconnect_storm"],
+                  "chaos_violations": 0, "invariant_checks": 31,
+                  "per_class_p95": {"gold": 0.8, "silver": 1.3,
+                                    "batch": 2.0},
+                  "net_frames": 412, "net_stall_drops": 1,
+                  "net_resumes": 3, "net_reconnects": 4,
+                  "net_forced_reconnects": 1, "net_dup_frames": 0,
+                  "net_gap_frames": 0, "net_malformed": 0,
+                  "net_backoffs": 2,
+                  "tick_p50_baseline_ms": 4.1, "tick_p50_wedged_ms": 4.4,
+                  "tick_wedged_ratio": 1.073,
+                  "outcomes": {"OK": 14, "SHED": 2}}
+
+    def fake_child(args, timeout_s, cpu_only=False):
+        if args[0] == "--probe":
+            return {"ok": True, "platform": "tpu", "n_devices": 1}, None
+        for spec in args[1].split(","):
+            _emit(bench, {"phase": "start", "spec": spec})
+            rec = _result(spec, 100.0)
+            if rec["mode"] == "netfront":
+                rec.update(net_fields)
+            _emit(bench, rec)
+        _emit(bench, {"phase": "done"})
+        return {"ok": True, "phase": "done"}, None
+
+    monkeypatch.setattr(bench, "_run_child", fake_child)
+    out = _run_main(bench, capsys)
+    net_recs = [v for v in out["all_variants"] if v["mode"] == "netfront"]
+    assert net_recs, "spec list must carry a netfront variant"
+    for v in net_recs:
+        for k, want in net_fields.items():
+            assert v[k] == want, (k, v)
+    assert "degraded" not in out  # zero violations: artifact stays clean
+
+
 def test_autoscale_record_fields_survive_embedding(bench, monkeypatch, capsys):
     """An autoscale-mode child record's elastic-fleet fields (recovery
     clock, warm-vs-cold bring-up, spawn/heal counters, warm-start store
@@ -443,7 +486,7 @@ def test_killed_serve_retries_untried_first(bench, monkeypatch, capsys):
     monkeypatch.setattr(bench, "_run_child", fake_child)
     out = _run_main(bench, capsys)
     assert state["round"] == 3
-    assert len(out["all_variants"]) == 12
+    assert len(out["all_variants"]) == 13
     assert out["value"] == 300.0
     assert "killed during" not in out.get("notes", "")  # retried successfully
 
@@ -469,7 +512,7 @@ def test_deterministic_error_not_retried(bench, monkeypatch, capsys):
     out = _run_main(bench, capsys)
     assert state["serves"] == 2  # dev + mesh children; error is final: no retry
     assert "non-finite" in out["notes"]
-    assert len(out["all_variants"]) == 11
+    assert len(out["all_variants"]) == 12
 
 
 def test_malformed_bench_variants_flagged(bench, monkeypatch, capsys):
@@ -511,7 +554,7 @@ def test_done_record_authoritative_over_stdout_marker(bench, monkeypatch, capsys
     out = _run_main(bench, capsys)
     assert state["serves"] == 2  # dev + mesh children; no retry round
     assert "serve:" not in out.get("notes", "")
-    assert len(out["all_variants"]) == 12
+    assert len(out["all_variants"]) == 13
     assert "degraded" not in out
 
 

@@ -1,0 +1,692 @@
+"""Streaming network front door (ISSUE 20 tentpole).
+
+Pins the protocol boundary's four contracts, all over REAL loopback
+sockets (no network leaves the box — the ``net`` marker suite stays
+CPU-green):
+
+* **wire protocol** — ACK frame echoes tag + clamped priority, token
+  frames stream incrementally, the terminal frame's ``n_tokens`` is
+  authoritative, malformed lines come back as structured error lines
+  without costing the connection, heartbeats pulse on the injected
+  clock;
+* **backpressure** — a wedged reader stalls ONLY its own connection
+  (``net.stall`` after the kernel buffer backs up into the bounded
+  userspace buffer), is dropped with a structured ``net.stall_drop``
+  past the timeout, and never slows the engine tick (the wedged-reader
+  latency-ratio assertion is the ISSUE 20 acceptance gate);
+* **exactly-once delivery** — repeated mid-stream disconnects resume via
+  ``{"resume": id, "have_seq": n}`` with zero duplicate and zero lost
+  tokens, judged by :meth:`InvariantMonitor.check_streams` against the
+  engine's own token lists; refused requests back off no earlier than
+  the server's ``retry_after_s`` hint (fake-clock drill), and a
+  brownout-capped batch-tier stream still terminates with a ``browned``
+  marker frame;
+* **drain + chaos** — ``begin_drain`` refuses new submissions with
+  terminal REJECTED frames carrying ``retry_after_s``, ``drain()``
+  flushes every terminal frame before closing; :func:`run_net_chaos`
+  under all four net fault kinds plus a forced mid-stream reconnect
+  closes strict-clean and renders through tools/chaos_report.py.
+
+The protocol/backpressure tests run against a scripted ``FakeEngine``
+(deterministic token schedules, no device work); the bit-identity,
+brownout, latency and chaos drills run against a live micro engine.
+"""
+
+import importlib.util
+import json
+import os
+import socket
+import time
+import types
+
+import numpy as np
+import pytest
+
+from csat_tpu.data.toy import random_request_sample
+from csat_tpu.resilience import (
+    FaultEvent,
+    FaultPlan,
+    InvariantMonitor,
+)
+from csat_tpu.resilience.chaos import NET_KINDS, run_net_chaos
+from csat_tpu.serve import (
+    RequestStatus,
+    ServeEngine,
+    collate_requests,
+    make_trace,
+    zoo_spec,
+)
+from csat_tpu.serve.netclient import NetClient
+from csat_tpu.serve.netfront import NetFront, encode_frame
+
+pytestmark = pytest.mark.net
+
+SRC_V, TGT_V, TRIP_V = 200, 300, 50
+
+
+# ---------------------------------------------------------------------------
+# harness: fake clock, scripted engine, co-sim driver
+# ---------------------------------------------------------------------------
+
+
+class FakeClock:
+    """Injectable monotonic clock — stall timeouts and backoff waits are
+    measured on it, so the drills advance time without sleeping."""
+
+    def __init__(self, t: float = 0.0):
+        self.t = float(t)
+
+    def __call__(self) -> float:
+        return self.t
+
+
+class FakeEngine:
+    """Scripted engine behind the front door: the wire ``sample`` IS the
+    token list the 'decode' will emit (``per_tick`` tokens per tick), so
+    protocol tests are deterministic and run in milliseconds.  Exposes
+    exactly the public surface NetFront composes against: submit / poll
+    / pop_result / tick / partial_tokens / queue_depth / occupancy."""
+
+    def __init__(self, cfg, per_tick: int = 2, reject_first: int = 0,
+                 retry_hint=None, clock=None):
+        self.cfg = cfg
+        self.clock = clock if clock is not None else time.monotonic
+        self.per_tick = per_tick
+        self.reject_first = reject_first
+        self.retry_hint = retry_hint
+        self.ticks = 0
+        self._next_id = 0
+        self._live = {}      # sid -> {"tokens", "emitted", "priority"}
+        self._results = {}   # sid -> terminal result
+
+    def _terminal(self, status, tokens, priority, error=None):
+        return types.SimpleNamespace(
+            status=status,
+            tokens=None if tokens is None else np.asarray(tokens, np.int32),
+            priority=priority, retry_after_s=self.retry_hint
+            if status in (RequestStatus.REJECTED, RequestStatus.SHED)
+            else None, error=error, browned=False)
+
+    def submit(self, sample, max_new_tokens=0, priority=0):
+        sid = self._next_id
+        self._next_id += 1
+        if self.reject_first > 0:
+            self.reject_first -= 1
+            self._results[sid] = self._terminal(
+                RequestStatus.REJECTED, None, int(priority), "queue full")
+            return sid
+        self._live[sid] = {"tokens": [int(t) for t in sample],
+                           "emitted": 0, "priority": int(priority)}
+        return sid
+
+    def tick(self):
+        self.ticks += 1
+        for sid, st in list(self._live.items()):
+            st["emitted"] = min(len(st["tokens"]),
+                                st["emitted"] + self.per_tick)
+            if st["emitted"] >= len(st["tokens"]):
+                self._results[sid] = self._terminal(
+                    RequestStatus.OK, st["tokens"], st["priority"])
+                del self._live[sid]
+
+    def partial_tokens(self):
+        return {sid: np.asarray(st["tokens"][:st["emitted"]], np.int32)
+                for sid, st in self._live.items()}
+
+    def poll(self, sid):
+        return self._results.get(sid)
+
+    def pop_result(self, sid):
+        return self._results.pop(sid)
+
+    @property
+    def queue_depth(self):
+        return 0
+
+    @property
+    def occupancy(self):
+        return len(self._live)
+
+
+def _drive(front, client, max_iters=4000):
+    """Single-threaded co-sim loop (the run_net_chaos interleave): step
+    both sides until every client stream AND pending retry has resolved."""
+    for _ in range(max_iters):
+        front.step()
+        client.step()
+        if (client.pending() == 0 and client.retry_pending() == 0
+                and not front._streams):
+            break
+    front.step()   # final flush of any terminal frames still buffered
+    client.step()
+
+
+# ---------------------------------------------------------------------------
+# real-engine stack (mirrors tests/test_chaos.py)
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def net_cfg(micro_config):
+    """Deterministic micro config on the bit-identity paths, 2 slots over
+    a single prefill bucket, three tenant tiers."""
+    return micro_config.replace(
+        full_att=True, dropout=0.0, attention_dropout=0.0,
+        cse_empty_rows="zero", serve_slots=2,
+        bucket_src_lens=(48,), serve_priority_classes=3,
+    )
+
+
+@pytest.fixture(scope="module")
+def stack(net_cfg):
+    from csat_tpu.train.state import (
+        create_train_state,
+        default_optimizer,
+        make_model,
+    )
+
+    cfg = net_cfg
+    model = make_model(cfg, SRC_V, TGT_V, TRIP_V)
+    warm = collate_requests(
+        [random_request_sample(cfg, SRC_V, TRIP_V, 8, seed=0)],
+        cfg.max_src_len, 1, cfg, tgt_width=cfg.max_tgt_len - 1)
+    params = create_train_state(
+        model, default_optimizer(cfg), warm, seed=0).params
+    return cfg, model, params
+
+
+def _requests(cfg, n, seed=0, lo=5):
+    rng = np.random.default_rng(seed)
+    return [
+        random_request_sample(cfg, SRC_V, TRIP_V, int(ln),
+                              seed=1000 * seed + i)
+        for i, ln in enumerate(rng.integers(lo, cfg.max_src_len, n))
+    ]
+
+
+# ---------------------------------------------------------------------------
+# wire protocol
+# ---------------------------------------------------------------------------
+
+
+def test_protocol_roundtrip_bit_identical(stack):
+    """End to end over a real socket against the live engine: every
+    stream terminates OK, the client assembly is bit-identical to the
+    front door's authoritative tokens, the ACK echoed the priority, and
+    the scrape counters moved."""
+    cfg, model, params = stack
+    eng = ServeEngine(model, params, cfg, sample_seed=0)
+    samples = _requests(cfg, 4, seed=11)
+    front = NetFront(eng, make_sample=lambda m: samples[int(m["sample"])])
+    client = NetClient(front.address)
+    tags = [client.submit(i, priority=i % 3, max_new_tokens=4)
+            for i in range(4)]
+    _drive(front, client)
+
+    authoritative = front.streams()
+    for i, tag in enumerate(tags):
+        st = client.streams[tag]
+        assert st.done and st.status == RequestStatus.OK
+        assert st.id is not None and st.id >= 0
+        assert st.priority == i % 3          # ACK + terminal echo
+        assert st.tokens == authoritative[st.id]
+        assert len(st.tokens) == st.n_tokens > 0
+    assert client.dup_total() == 0 and client.gap_total() == 0
+    assert client.results() == {sid: toks for sid, toks
+                                in authoritative.items()}
+    assert eng.stats.net_frames > 0
+    assert eng.stats.net_connections == 1
+
+    mon = InvariantMonitor(cfg)
+    assert mon.check_streams(front, client) == []
+    front.close()
+    client.close()
+    assert eng.stats.net_connections == 0
+    eng.close()
+
+
+def test_malformed_lines_survive_connection(micro_config):
+    """Garbage on the wire costs an error line + a counter, never the
+    connection — the stream submitted after the garbage completes."""
+    eng = FakeEngine(micro_config)
+    front = NetFront(eng, make_sample=lambda m: m["sample"])
+    client = NetClient(front.address)
+    client.step()  # connect
+    client.send_garbage()                      # unparseable
+    client.send_garbage(b'{"what": 1}')        # parseable, unknown shape
+    tag = client.submit([7, 8, 9])
+    _drive(front, client)
+
+    assert front.counters["malformed"] == 2
+    assert client.errors >= 2                  # structured error lines
+    st = client.streams[tag]
+    assert st.done and st.status == RequestStatus.OK
+    assert st.tokens == [7, 8, 9]
+    assert front.counters["disconnects"] == 0
+    names = [e[1] for e in front.obs.events()]
+    assert names.count("net.malformed") == 2
+    front.close()
+    client.close()
+
+
+def test_heartbeats_on_injected_clock(micro_config):
+    """serve_net_heartbeat_s pulses ``{"hb": tick}`` on the injected
+    clock; a client heartbeat echo is liveness-only (no error line)."""
+    cfg = micro_config.replace(serve_net_heartbeat_s=1.0)
+    clk = FakeClock()
+    eng = FakeEngine(cfg, clock=clk)
+    front = NetFront(eng, make_sample=lambda m: m["sample"], clock=clk)
+    client = NetClient(front.address, clock=clk)
+    client.step()
+    front.step()
+    for _ in range(5):
+        clk.t += 1.1
+        front.step()
+        client.step()
+    assert client.hb_seen >= 4
+    assert client.errors == 0
+    front.close()
+    client.close()
+
+
+# ---------------------------------------------------------------------------
+# backpressure: stall accounting, stall drop, wedged-reader tick latency
+# ---------------------------------------------------------------------------
+
+
+def test_wedged_reader_stalls_then_drops(micro_config):
+    """A reader that never drains its socket: once the kernel buffers
+    back up into the bounded userspace buffer the connection is STALLED
+    (frames stop being appended for it), and past
+    serve_net_stall_timeout_s it is dropped with a structured
+    ``net.stall_drop`` — while the stream itself survives for resume."""
+    cfg = micro_config.replace(serve_net_client_buffer=512,
+                               serve_net_frame_ring=100000,
+                               serve_net_stall_timeout_s=5.0)
+    clk = FakeClock()
+    eng = FakeEngine(cfg, per_tick=0, clock=clk)  # stream never finishes
+    front = NetFront(eng, make_sample=lambda m: m["sample"], clock=clk)
+    wedge = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+    # tiny kernel buffers on both ends (RCVBUF must be set before
+    # connect) so backpressure reaches userspace after a few KB instead
+    # of the ~200KB loopback default
+    wedge.setsockopt(socket.SOL_SOCKET, socket.SO_RCVBUF, 1024)
+    wedge.connect(front.address)
+    wedge.sendall(encode_frame({"sample": list(range(8)), "tag": "w"}))
+    for _ in range(5):
+        front.step()
+        if front._streams:
+            break
+    assert 0 in front._streams
+    front._conns[0].sock.setsockopt(socket.SOL_SOCKET, socket.SO_SNDBUF,
+                                    1024)
+    # frame far more bytes than the kernel can absorb
+    st = front._streams[0]
+    fat = list(range(250))
+    for _ in range(256):
+        front._push_frame(st, {"tokens": fat})
+    stalled = False
+    for _ in range(400):
+        front._flush()
+        if front._conns and front._conns[0].stalled_since is not None:
+            stalled = True
+            break
+    assert stalled, "wedged reader never tripped stall accounting"
+    names = [e[1] for e in front.obs.events()]
+    assert "net.stall" in names and "net.stall_drop" not in names
+    assert front.counters["stall_drops"] == 0 and front._conns
+
+    clk.t += cfg.serve_net_stall_timeout_s + 1.0
+    front._flush()
+    assert front.counters["stall_drops"] == 1
+    assert not front._conns            # the wedged connection was dropped
+    assert 0 in front._streams         # ...the stream is untouched
+    names = [e[1] for e in front.obs.events()]
+    assert "net.stall_drop" in names
+    wedge.close()
+    front.close()
+
+
+@pytest.mark.chaos
+def test_wedged_reader_tick_latency_within_noise(stack):
+    """ISSUE 20 acceptance: with one wedged reader mid-stream, the
+    front-door step latency (which contains the engine tick) stays
+    within noise of the bare no-network tick — the engine never blocks
+    on a socket write.  The bench records the same ratio
+    (tick_wedged_ratio in the :netfront record)."""
+    cfg, model, params = stack
+    eng = ServeEngine(model, params, cfg, sample_seed=0)
+    samples = _requests(cfg, 2, seed=13)
+    eng.generate(samples, max_new_tokens=6)  # compile outside the timing
+
+    # baseline: bare engine ticks, no network anywhere
+    for s in samples:
+        eng.submit(s, max_new_tokens=6)
+    base = []
+    while eng.occupancy or eng.queue_depth:
+        t0 = time.perf_counter()
+        eng.tick()
+        base.append(time.perf_counter() - t0)
+    eng.drain()
+
+    # wedged: a socket client that submits and then never reads
+    front = NetFront(eng, make_sample=lambda m: samples[int(m["sample"])])
+    wedge = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+    wedge.connect(front.address)
+    wedge.sendall(encode_frame({"sample": 0, "max_new_tokens": 6}))
+    eng.submit(samples[1], max_new_tokens=6)
+    wedged = []
+    for _ in range(200):
+        t0 = time.perf_counter()
+        live = front.step()
+        wedged.append(time.perf_counter() - t0)
+        if not live and not eng.occupancy and not eng.queue_depth:
+            break
+    assert not front._streams  # the wedge's stream still finished
+
+    ratio = float(np.median(wedged) / max(np.median(base), 1e-9))
+    assert len(base) >= 3 and len(wedged) >= 3
+    assert ratio < 2.5, (
+        f"wedged reader slowed the tick {ratio:.2f}x "
+        f"(base p50 {np.median(base) * 1e3:.2f}ms, "
+        f"wedged p50 {np.median(wedged) * 1e3:.2f}ms)")
+    wedge.close()
+    front.close()
+    eng.close()
+
+
+# ---------------------------------------------------------------------------
+# exactly-once delivery: resume, backoff, browned marker
+# ---------------------------------------------------------------------------
+
+
+def test_resume_exactly_once_across_reconnects(micro_config):
+    """Three mid-stream disconnects: the client reconnects, resumes with
+    have_seq, and every assembly lands with zero duplicate and zero lost
+    tokens — exactly-once at the token level, judged by check_streams."""
+    eng = FakeEngine(micro_config, per_tick=1)
+    front = NetFront(eng, make_sample=lambda m: m["sample"])
+    client = NetClient(front.address)
+    toks = [[100 + 10 * j + k for k in range(8)] for j in range(3)]
+    tags = [client.submit(t) for t in toks]
+    for i in range(300):
+        front.step()
+        client.step()
+        if i in (2, 4, 6):
+            client.disconnect()  # after ACKs: ids are known, resume works
+        if client.pending() == 0 and not front._streams:
+            break
+    front.step()
+    client.step()
+
+    assert client.pending() == 0
+    assert client.reconnects >= 4 and client.resumes_sent > 0
+    assert front.counters["resumes"] == client.resumes_sent
+    assert client.dup_total() == 0 and client.gap_total() == 0
+    for tag, t in zip(tags, toks):
+        st = client.streams[tag]
+        assert st.done and st.status == RequestStatus.OK
+        assert st.tokens == t
+
+    mon = InvariantMonitor(micro_config)
+    assert mon.check_streams(front, client) == []
+    assert mon.checks > 0
+    front.close()
+    client.close()
+
+
+def test_refusal_backoff_honors_retry_after_hint(micro_config):
+    """Satellite drill: a REJECTED terminal frame carrying retry_after_s
+    schedules the resubmit no earlier than the hint, measured on a fake
+    clock — the client never hammers a refusing server."""
+    clk = FakeClock()
+    eng = FakeEngine(micro_config, reject_first=1, retry_hint=3.0,
+                     clock=clk)
+    front = NetFront(eng, make_sample=lambda m: m["sample"], clock=clk)
+    client = NetClient(front.address, clock=clk, retries=1)
+    tag = client.submit([5, 6, 7])
+    for _ in range(10):
+        front.step()
+        client.step()
+    st = client.streams[tag]
+    assert st.done and st.status == RequestStatus.REJECTED
+    assert st.retry_after_s == 3.0
+    assert client.retry_pending() == 1
+
+    clk.t = 2.9   # before the hint: still waiting
+    for _ in range(5):
+        front.step()
+        client.step()
+    assert client.retry_pending() == 1
+    assert client.streams[tag].status == RequestStatus.REJECTED
+
+    clk.t = 3.1   # past the hint: resubmit fires and completes
+    _drive(front, client)
+    assert client.backoffs == [3.0]
+    st = client.streams[tag]
+    assert st.done and st.status == RequestStatus.OK
+    assert st.tokens == [5, 6, 7]
+    front.close()
+    client.close()
+
+
+def test_brownout_capped_stream_carries_browned_marker(stack):
+    """Satellite drill: under a tight queue the brownout cap lands on
+    low-tier streams and their terminal frame says so (``browned``);
+    refused streams carry the retry_after_s backpressure hint."""
+    cfg, model, params = stack
+    tight = cfg.replace(
+        serve_max_queue=4, serve_queue_policy="shed_oldest",
+        serve_brownout_queue_frac=0.5, serve_brownout_max_new_tokens=2,
+        serve_retry_after_s=0.25)
+    eng = ServeEngine(model, params, tight, sample_seed=0)
+    samples = _requests(cfg, 12, seed=9)
+    front = NetFront(eng, make_sample=lambda m: samples[int(m["sample"])])
+    client = NetClient(front.address)
+    tags = [client.submit(i, priority=i % 3) for i in range(12)]
+    _drive(front, client)
+
+    sts = [client.streams[t] for t in tags]
+    assert all(st.done for st in sts)
+    browned = [st for st in sts if st.browned]
+    assert browned and all(st.priority > 0 for st in browned)
+    # browned-at-submit streams may still be shed later by admission
+    # control — but every browned stream reached a terminal frame that
+    # says so, and none of them belongs to the gold tier
+    assert all(st.n_tokens == len(st.tokens) for st in browned)
+    refused = [st for st in sts
+               if st.status in (RequestStatus.REJECTED, RequestStatus.SHED)]
+    assert refused
+    assert all(st.retry_after_s is not None and st.retry_after_s >= 0.25
+               for st in refused)
+    mon = InvariantMonitor(tight)
+    assert mon.check_streams(front, client) == []
+    front.close()
+    client.close()
+    eng.close()
+
+
+# ---------------------------------------------------------------------------
+# drain
+# ---------------------------------------------------------------------------
+
+
+def test_drain_refuses_new_submits_and_flushes_terminals(micro_config):
+    """SIGTERM posture: begin_drain refuses new submissions with a
+    synthetic terminal REJECTED frame carrying retry_after_s while the
+    in-flight stream finishes; drain() closes everything down."""
+    cfg = micro_config.replace(serve_retry_after_s=0.5)
+    eng = FakeEngine(cfg, per_tick=1)
+    front = NetFront(eng, make_sample=lambda m: m["sample"])
+    client = NetClient(front.address)
+    t1 = client.submit([1, 2, 3, 4])
+    for _ in range(3):
+        front.step()
+        client.step()
+    front.begin_drain()
+    t2 = client.submit([9, 9])
+    _drive(front, client)
+
+    st2 = client.streams[t2]
+    assert st2.done and st2.status == RequestStatus.REJECTED
+    assert st2.id is not None and st2.id < 0   # synthetic refusal id
+    assert st2.error == "draining"
+    assert st2.retry_after_s == 0.5
+    assert st2.tokens == [] and st2.n_tokens == 0
+    st1 = client.streams[t1]
+    assert st1.done and st1.status == RequestStatus.OK
+    assert st1.tokens == [1, 2, 3, 4]          # in-flight work finished
+    assert front.counters["refused"] == 1
+
+    front.drain()
+    assert front._lsock is None and not front._conns
+    client.close()
+
+
+# ---------------------------------------------------------------------------
+# net chaos drill
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.chaos
+def test_net_chaos_drill_strict_clean(stack, tmp_path, capsys):
+    """All four net fault kinds plus one forced mid-stream reconnect
+    against the live engine: zero invariant violations (strict raises
+    otherwise), every request terminal, and the dumped timeline renders
+    through tools/chaos_report.py with the net ladder in the header."""
+    cfg, model, params = stack
+    eng = ServeEngine(model, params, cfg, sample_seed=0)
+    trace = make_trace(
+        zoo_spec("bursty_multitenant", 10, seed=8, mean_interarrival=0.5),
+        cfg, SRC_V, TRIP_V)
+    assert set(NET_KINDS) == {"disconnect_mid_stream", "slow_reader",
+                              "malformed_frame", "reconnect_storm"}
+    plan = FaultPlan((
+        FaultEvent("slow_reader", at=2, count=1),
+        FaultEvent("disconnect_mid_stream", at=5),
+        FaultEvent("malformed_frame", at=8, count=2),
+        FaultEvent("reconnect_storm", at=12, count=1),
+    ), name="net_drill")
+    mon = InvariantMonitor(cfg, postmortem_dir=str(tmp_path))
+    report = run_net_chaos(eng, trace, plan=plan, monitor=mon,
+                           strict=True, retries=1, force_reconnect=True)
+
+    assert report.clean and report.checks > 0
+    assert sum(report.outcomes.values()) == len(trace)
+    assert "UNRESOLVED" not in report.outcomes
+    assert report.net["forced_reconnects"] == 1
+    assert report.net["reconnects"] >= 2       # storm + forced + initial
+    assert report.net["resumes_sent"] > 0
+    assert report.net["malformed"] >= 1
+    assert report.net["dup_frames"] == 0 and report.net["gap_frames"] == 0
+    assert eng.occupancy == 0 and eng.queue_depth == 0
+
+    # artifact round-trips through the renderer with the net header line
+    path = report.dump(str(tmp_path / "net_chaos.jsonl"))
+    spec = importlib.util.spec_from_file_location(
+        "chaos_report", os.path.join(
+            os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+            "tools", "chaos_report.py"))
+    chaos_report = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(chaos_report)
+    assert chaos_report.main([path]) == 0
+    out = capsys.readouterr().out
+    assert "net:" in out and "reconnects=" in out
+    meta, events = chaos_report.load_dump(path)
+    assert meta["violations"] == 0
+    assert meta["net"]["forced_reconnects"] == 1
+    assert any(e["name"].startswith("net.") for e in events)
+    eng.close()
+
+
+# ---------------------------------------------------------------------------
+# CLI teardown (satellite: drain path flushes telemetry before exit)
+# ---------------------------------------------------------------------------
+
+
+class _BoomEngine:
+    """Engine whose first tick dies mid-flight — the teardown-stack
+    regression: finalize() and close() must still run."""
+
+    def __init__(self, cfg, closed):
+        self.cfg = cfg
+        self.clock = time.monotonic
+        self.occupancy = 1
+        self.queue_depth = 0
+        self._closed = closed
+
+    def tick(self):
+        raise RuntimeError("boom mid-flight")
+
+    def close(self):
+        self._closed.append("close")
+
+    def partial_tokens(self):
+        return {}
+
+    def poll(self, sid):
+        return None
+
+
+def _cli_args():
+    return types.SimpleNamespace(slo=False, heartbeat_s=0.0,
+                                 drain_deadline_s=1.0, max_new_tokens=8)
+
+
+def test_cli_serve_crash_still_flushes_telemetry(monkeypatch, micro_config):
+    """The stdin JSONL loop's flight-recorder guarantee: a crash inside
+    the loop (poison budget, rebuild cap, anything) unwinds through
+    engine.close() AND the telemetry finalize() — the final metrics
+    window is never lost."""
+    from csat_tpu.serve import cli
+
+    ran = []
+    eng = _BoomEngine(micro_config, ran)
+    monkeypatch.setattr(cli, "build_engine",
+                        lambda a: (eng, micro_config, None, None))
+    monkeypatch.setattr(
+        cli, "_telemetry",
+        lambda e, c, a: (None, dict, lambda: ran.append("finalize")))
+    monkeypatch.setattr("sys.stdin", open(os.devnull))
+    with pytest.raises(RuntimeError, match="boom"):
+        cli._serve(_cli_args())
+    assert ran == ["close", "finalize"]  # LIFO: close first, then flush
+
+
+def test_cli_serve_net_crash_drains_front_and_flushes(monkeypatch,
+                                                      micro_config):
+    """Same guarantee for the --net loop, plus the front door itself:
+    the teardown drains the front (terminal frames + socket close)
+    before the engine closes and telemetry flushes."""
+    import csat_tpu.serve.netfront as netfront_mod
+    from csat_tpu.serve import cli
+
+    ran = []
+    created = []
+    eng = _BoomEngine(micro_config, ran)
+    orig = netfront_mod.NetFront
+
+    def capture(*a, **k):
+        f = orig(*a, **k)
+        created.append(f)
+        return f
+
+    monkeypatch.setattr(netfront_mod, "NetFront", capture)
+    monkeypatch.setattr(cli, "build_engine",
+                        lambda a: (eng, micro_config, None, None))
+    monkeypatch.setattr(
+        cli, "_telemetry",
+        lambda e, c, a: (None, dict, lambda: ran.append("finalize")))
+    with pytest.raises(RuntimeError, match="boom"):
+        cli._serve_net(_cli_args())
+    assert ran == ["close", "finalize"]
+    assert created and created[0]._lsock is None  # front drained + closed
+
+
+def test_cli_net_flag_routes_to_front_door():
+    """--net routes serve to the front-door loop (dispatch contract)."""
+    from csat_tpu.serve.cli import _parser
+
+    args = _parser().parse_args(["--config", "python", "--net"])
+    assert args.net is True
+    assert _parser().parse_args(["--config", "python"]).net is False

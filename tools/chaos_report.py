@@ -31,12 +31,13 @@ from typing import List, Tuple
 # event-name prefixes that make the condensed timeline: injected faults,
 # the degradation ladder acting, the invariant monitor's verdicts, the
 # elastic-fleet lifecycle (spawn/heal — ISSUE 13), SLO burn-rate alert
-# transitions (ISSUE 14), and the tiered KV store's spill/demote/restore/
-# restore_miss ladder (ISSUE 16)
+# transitions (ISSUE 14), the tiered KV store's spill/demote/restore/
+# restore_miss ladder (ISSUE 16), and the network front door's
+# connect/stall/resume/drop ladder (ISSUE 20)
 TIMELINE_PREFIXES = (
     "fault.", "invariant.", "req.brownout", "fleet.shed_oldest",
     "fleet.retire", "fleet.resubmit", "fleet.backoff", "fleet.draining",
-    "fleet.spawn", "autoscale.", "slo.", "tier.",
+    "fleet.spawn", "autoscale.", "slo.", "tier.", "net.",
 )
 
 
@@ -86,6 +87,10 @@ def header_lines(meta: dict) -> List[str]:
     if slo:
         out.append("  slo alerts: " + "  ".join(
             f"{k}={v}" for k, v in sorted(slo.items())))
+    net = meta.get("net") or {}
+    if net:
+        out.append("  net: " + "  ".join(
+            f"{k}={v}" for k, v in sorted(net.items())))
     verdict = "CLEAN" if not meta.get("violations") else "VIOLATED"
     out.append(f"  verdict: {verdict}")
     return out

@@ -232,6 +232,34 @@ def kv_pages_table(snaps: List[dict]) -> str:
     return _fmt_table(rows, headers)
 
 
+# network front-door gauges/counters (serve/netfront.py, ISSUE 20):
+# rendered per replica whenever any snapshot carries the connection gauge
+_NET_COLS = (
+    ("conns", "serve_net_connections"),
+    ("stalled", "serve_net_stalled"),
+    ("frames", "serve_net_frames_total"),
+    ("stall_drops", "serve_net_stall_drops_total"),
+    ("resumes", "serve_net_resumes_total"),
+    ("disconnects", "serve_net_disconnects_total"),
+    ("malformed", "serve_net_malformed_total"),
+)
+
+
+def net_table(snaps: List[dict]) -> str:
+    """Network front-door connection/stall/resume table (ISSUE 20) —
+    one row per replica reporting the ``serve_net_*`` series; returns ""
+    when no snapshot ran behind a front door."""
+    rows: List[Tuple] = []
+    for k, s in enumerate(snaps):
+        if s.get("serve_net_connections") is None:
+            continue
+        rows.append((f"replica{s.get('_index', k)}",
+                     *(s.get(key, 0) or 0 for _, key in _NET_COLS)))
+    if not rows:
+        return ""
+    return _fmt_table(rows, ("replica", *(c for c, _ in _NET_COLS)))
+
+
 def trace_lines(path: str, slowest: int = 5) -> List[str]:
     """The slowest-N request traces from a ``Tracer.dump`` JSONL artifact
     (ISSUE 14) as indented span trees — one header row per trace (id,
@@ -332,6 +360,9 @@ def report(metrics_path: Optional[str] = None,
         pages = kv_pages_table(snaps)
         if pages:
             sections.append("== kv pages (per tier) ==\n" + pages)
+        net = net_table(snaps)
+        if net:
+            sections.append("== net front door ==\n" + net)
     if metrics_path:
         snaps = load_metrics(metrics_path)
         if snaps:
@@ -350,6 +381,9 @@ def report(metrics_path: Optional[str] = None,
             pages = kv_pages_table([last])
             if pages:
                 sections.append("== kv pages (per tier) ==\n" + pages)
+            net = net_table([last])
+            if net:
+                sections.append("== net front door ==\n" + net)
     if events_path:
         meta, events = load_events(events_path)
         title = meta.get("component") or meta.get("source") or "events"

@@ -37,6 +37,7 @@ from tools.obs_report import (  # noqa: E402
     _fmt_table,
     fleet_table,
     kv_pages_table,
+    net_table,
     split_fleet_snapshot,
     trace_lines,
 )
@@ -108,6 +109,16 @@ def pages_lines(snaps: List[dict]) -> List[str]:
     return ["== kv pages ==", *table.splitlines()]
 
 
+def net_lines(snaps: List[dict]) -> List[str]:
+    """Network front-door connection/stall/resume columns (ISSUE 20) —
+    shown whenever a replica serves behind ``--net`` (shared renderer
+    with ``tools/obs_report.py``)."""
+    table = net_table(snaps)
+    if not table:
+        return []
+    return ["== net front door ==", *table.splitlines()]
+
+
 def slo_lines(snap: dict) -> List[str]:
     """Burn-rate table + active alerts from the ``slo_*`` gauges the SLO
     engine writes into the scrape registry."""
@@ -146,10 +157,16 @@ def render(metrics_path: str, traces_path: str = "",
         pages = pages_lines(replicas)
         if pages:
             lines += [""] + pages
+        net = net_lines(replicas)
+        if net:
+            lines += [""] + net
     else:
         pages = pages_lines([snap])
         if pages:
             lines += [""] + pages
+        net = net_lines([snap])
+        if net:
+            lines += [""] + net
     slo = slo_lines(snap)
     if slo:
         lines += [""] + slo
