@@ -279,6 +279,15 @@ class NetClient:
                 st.lost = True
             return
         if "error" in msg and _SEQ not in msg:
+            sid = msg.get("resume")
+            if isinstance(sid, int) and not isinstance(sid, bool):
+                # resume refused ({"resume": sid, "error": "unknown"}):
+                # the server evicted the stream past its done-retention
+                # (or restarted) — the remaining frames are gone, so
+                # terminate honestly instead of pending forever
+                st = self._by_id.get(sid)
+                if st is not None and not st.done:
+                    st.lost = True
             self.errors += 1
             return
         if "id" not in msg or _SEQ not in msg:

@@ -247,6 +247,14 @@ class NetFront:
             st.sent_tokens += len(part)
             i += len(part)
 
+    def _retain_done(self, st: _Stream) -> None:
+        """Move a terminal stream into the bounded ``_done`` retention
+        FIFO — every insertion path shares this trim, so a submit flood
+        of drain refusals can't grow retention without bound."""
+        self._done[st.id] = st
+        while len(self._done) > self.cfg.serve_net_done_retain:
+            self._done.pop(next(iter(self._done)))
+
     def _finish_stream(self, st: _Stream, req: Any) -> None:
         full: List[int] = (
             [int(t) for t in req.tokens.tolist()]
@@ -271,10 +279,7 @@ class NetFront:
             term["error"] = str(req.error)
         self._push_frame(st, term)
         self._streams.pop(st.id, None)
-        self._done[st.id] = st
-        while len(self._done) > self.cfg.serve_net_done_retain:
-            old = next(iter(self._done))
-            self._done.pop(old)
+        self._retain_done(st)
         self.obs.emit("net.stream_done", id=st.id, status=req.status,
                       n_tokens=len(full), frames=st.next_seq)
 
@@ -306,13 +311,18 @@ class NetFront:
         st.done = True
         st.status = RequestStatus.REJECTED
         conn.cursors[st.id] = 0
-        self._done[st.id] = st
+        self._retain_done(st)
         self._count("refused")
         self.obs.emit("net.refuse", error=error, priority=priority)
 
     def _handle_submit(self, conn: _Conn, msg: Dict[str, Any]) -> None:
         tag = msg.get("tag")
-        priority = int(msg.get("priority", 0))
+        try:
+            priority = int(msg.get("priority", 0))
+            max_new = int(msg.get("max_new_tokens", 0))
+        except (TypeError, ValueError):
+            self._note_malformed(conn, "bad priority/max_new_tokens")
+            return
         if self.draining:
             self._refusal(conn, tag, priority, "draining")
             return
@@ -326,8 +336,7 @@ class NetFront:
             return
         try:
             sid = self.target.submit(
-                sample, max_new_tokens=int(msg.get("max_new_tokens", 0)),
-                priority=priority)
+                sample, max_new_tokens=max_new, priority=priority)
         except Exception as e:
             # poison-budget exhaustion (DataErrorBudgetExceeded) and kin:
             # the front door stays up — the caller gets a structured
@@ -354,6 +363,12 @@ class NetFront:
 
     def _handle_resume(self, conn: _Conn, msg: Dict[str, Any]) -> None:
         sid = msg.get("resume")
+        # stream ids are ints end to end; anything else is a protocol
+        # violation (an unhashable sid would otherwise blow up the dict
+        # lookups below and take the serve loop down with it)
+        if not isinstance(sid, int) or isinstance(sid, bool):
+            self._note_malformed(conn, "bad resume id")
+            return
         try:
             have = int(msg.get("have_seq", -1))
         except (TypeError, ValueError):
@@ -383,14 +398,21 @@ class NetFront:
         if not isinstance(msg, dict):
             self._note_malformed(conn, "not an object")
             return
-        if "resume" in msg:
-            self._handle_resume(conn, msg)
-        elif "sample" in msg:
-            self._handle_submit(conn, msg)
-        elif "hb" in msg:
-            pass  # client heartbeat echo: liveness only
-        else:
-            self._note_malformed(conn, "unknown message")
+        try:
+            if "resume" in msg:
+                self._handle_resume(conn, msg)
+            elif "sample" in msg:
+                self._handle_submit(conn, msg)
+            elif "hb" in msg:
+                pass  # client heartbeat echo: liveness only
+            else:
+                self._note_malformed(conn, "unknown message")
+        except Exception as e:
+            # last-resort backstop for the module contract: a
+            # client-supplied payload is NEVER fatal to the front door —
+            # a wrong-typed field the handlers missed costs the sender
+            # an error line, not every client the server
+            self._note_malformed(conn, f"bad message: {e}")
 
     # ---------------- sockets ----------------
 
@@ -588,7 +610,7 @@ class NetFront:
             st.done = True
             st.status = RequestStatus.SHED
             self._streams.pop(st.id, None)
-            self._done[st.id] = st
+            self._retain_done(st)
         for _ in range(8):
             if not any(c.out or c.cursors for c in self._conns):
                 break

@@ -269,6 +269,34 @@ def test_malformed_lines_survive_connection(micro_config):
     client.close()
 
 
+def test_wrong_typed_fields_never_fatal(micro_config):
+    """Review regression: a well-formed JSON object with wrong-TYPED
+    fields (``priority: "high"``, an unhashable resume id) costs the
+    sender an error line, never an exception through ``step()`` — the
+    front door keeps serving the next honest submit."""
+    eng = FakeEngine(micro_config)
+    front = NetFront(eng, make_sample=lambda m: m["sample"])
+    client = NetClient(front.address)
+    client.step()  # connect
+    client.send_garbage(b'{"sample": [1], "priority": "high"}')
+    client.send_garbage(b'{"sample": [1], "max_new_tokens": [9]}')
+    client.send_garbage(b'{"resume": [1], "have_seq": 0}')
+    client.send_garbage(b'{"resume": {"x": 1}}')
+    client.send_garbage(b'{"resume": 0, "have_seq": "zero"}')
+    tag = client.submit([4, 5])
+    _drive(front, client)
+
+    assert front.counters["malformed"] == 5
+    assert front.counters["disconnects"] == 0
+    st = client.streams[tag]
+    assert st.done and st.status == RequestStatus.OK
+    assert st.tokens == [4, 5]
+    names = [e[1] for e in front.obs.events()]
+    assert names.count("net.malformed") == 5
+    front.close()
+    client.close()
+
+
 def test_heartbeats_on_injected_clock(micro_config):
     """serve_net_heartbeat_s pulses ``{"hb": tick}`` on the injected
     clock; a client heartbeat echo is liveness-only (no error line)."""
@@ -435,6 +463,36 @@ def test_resume_exactly_once_across_reconnects(micro_config):
     client.close()
 
 
+def test_resume_unknown_terminates_stream_lost(micro_config):
+    """Review regression: a server that no longer knows a stream id
+    (restart / retention eviction) answers the resume with an error
+    line — the client marks the stream LOST so ``pending()`` drains
+    instead of spinning a driver forever."""
+    eng = FakeEngine(micro_config, per_tick=1)
+    front = NetFront(eng, make_sample=lambda m: m["sample"])
+    host, port = front.address
+    client = NetClient(front.address)
+    tag = client.submit(list(range(50)))
+    for _ in range(3):   # far enough for the ACK, nowhere near terminal
+        front.step()
+        client.step()
+    st = client.streams[tag]
+    assert st.id is not None and not st.done
+    front.close()        # "server restart": every stream record is gone
+    front2 = NetFront(FakeEngine(micro_config),
+                      make_sample=lambda m: m["sample"],
+                      host=host, port=port)
+    _drive(front2, client)
+
+    assert st.lost and not st.done
+    assert client.pending() == 0           # terminates honestly
+    assert st.id not in client.results()   # evidence, not a result
+    names = [e[1] for e in front2.obs.events()]
+    assert "net.resume_unknown" in names
+    front2.close()
+    client.close()
+
+
 def test_refusal_backoff_honors_retry_after_hint(micro_config):
     """Satellite drill: a REJECTED terminal frame carrying retry_after_s
     schedules the resubmit no earlier than the hint, measured on a fake
@@ -540,6 +598,36 @@ def test_drain_refuses_new_submits_and_flushes_terminals(micro_config):
 
     front.drain()
     assert front._lsock is None and not front._conns
+    client.close()
+
+
+def test_drain_refusal_flood_bounded_retention(micro_config):
+    """Review regression: a submit flood against a draining front door
+    cannot grow the done-stream retention without bound — ``_refusal``
+    applies the same ``serve_net_done_retain`` trim as a normal
+    stream retirement."""
+    cfg = micro_config.replace(serve_net_done_retain=4,
+                               serve_retry_after_s=0.5)
+    eng = FakeEngine(cfg)
+    front = NetFront(eng, make_sample=lambda m: m["sample"])
+    client = NetClient(front.address)
+    client.step()  # connect
+    front.step()   # accept before the drain posture refuses new conns
+    front.begin_drain()
+    tags = []
+    for i in range(12):
+        tags.append(client.submit([i]))
+        front.step()
+        client.step()
+    _drive(front, client)
+
+    assert front.counters["refused"] == 12
+    assert len(front._done) <= 4
+    sts = [client.streams[t] for t in tags]
+    assert all(st.done and st.status == RequestStatus.REJECTED
+               for st in sts)
+    assert all(st.retry_after_s == 0.5 for st in sts)
+    front.close()
     client.close()
 
 
